@@ -1,0 +1,43 @@
+"""dmlc_core_tpu — a TPU-native framework with the capabilities of dmlc-core.
+
+The reference (/root/reference, cjolivier01/dmlc-core) is the C++11 common-support
+library under XGBoost/MXNet: a parameter/registry/config/logging substrate, a
+URI-dispatched virtual filesystem + streaming serialization layer, a sharded
+threaded record-input pipeline with text/binary parsers, and a Python tracker
+for distributed job launch and rank rendezvous.
+
+This package provides the same surface, redesigned TPU-first:
+
+- ``dmlc_core_tpu.utils``     — logging/CHECK substrate, timers, small helpers
+  (reference: include/dmlc/logging.h, timer.h, common.h).
+- ``dmlc_core_tpu.param``     — reflected parameter structs with
+  declare/default/range/enum/doc/JSON semantics (reference: include/dmlc/parameter.h).
+- ``dmlc_core_tpu.registry``  — name->factory registries with aliases
+  (reference: include/dmlc/registry.h).
+- ``dmlc_core_tpu.config``    — key=value config files (reference: include/dmlc/config.h).
+- ``dmlc_core_tpu.serializer``— typed binary serialization onto streams
+  (reference: include/dmlc/serializer.h).
+- ``dmlc_core_tpu.io``        — Stream/SeekStream, URI-dispatched filesystems,
+  RecordIO, InputSplit sharding engine, ThreadedIter
+  (reference: include/dmlc/io.h, src/io/).
+- ``dmlc_core_tpu.data``      — RowBlock CSR batches, libsvm/libfm/csv parsers,
+  row iterators (reference: include/dmlc/data.h, src/data/).
+- ``dmlc_core_tpu.bridge``    — RowBlock -> mesh-placed jax.Array batches
+  (the TPU-native recast of ThreadedIter feeding device infeed).
+- ``dmlc_core_tpu.collective``— Rabit-shaped allreduce/broadcast implemented as
+  jax.lax collectives over ICI/DCN (replaces tracker-brokered TCP trees).
+- ``dmlc_core_tpu.parallel``  — device-mesh construction and sharding helpers.
+- ``dmlc_core_tpu.ops``/``models`` — TPU compute: histogram/sketch ops, linear
+  models, hist-GBDT (the XGBoost-hist-on-TPU north star).
+- ``dmlc_core_tpu.tracker``   — dmlc-submit-compatible launcher + rendezvous
+  (reference: tracker/dmlc_tracker/).
+
+JAX is imported lazily (only by bridge/collective/parallel/ops/models) so the
+pure host-side layers work in minimal environments.
+"""
+
+__version__ = "0.1.0"
+
+from dmlc_core_tpu.utils.logging import Error, CHECK, CHECK_EQ, LOG  # noqa: F401
+from dmlc_core_tpu.param import Parameter, ParamError, field, get_env  # noqa: F401
+from dmlc_core_tpu.registry import Registry  # noqa: F401

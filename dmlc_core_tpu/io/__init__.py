@@ -1,0 +1,24 @@
+"""IO layer: streams, URI-dispatched filesystems, RecordIO, InputSplit, ThreadedIter.
+
+Reference: include/dmlc/io.h, include/dmlc/recordio.h, include/dmlc/threadediter.h,
+src/io/ (the compiled virtual-filesystem + sharded-input engine).
+"""
+
+from dmlc_core_tpu.io.stream import (  # noqa: F401
+    Stream,
+    SeekStream,
+    Serializable,
+    create_stream,
+    create_stream_for_read,
+)
+from dmlc_core_tpu.io.memory_io import MemoryFixedSizeStream, MemoryStringStream  # noqa: F401
+from dmlc_core_tpu.io.filesys import URI, FileInfo, FileSystem, FileType  # noqa: F401
+from dmlc_core_tpu.io.recordio import (  # noqa: F401
+    RECORDIO_MAGIC,
+    RecordIOWriter,
+    RecordIOReader,
+    RecordIOChunkReader,
+)
+from dmlc_core_tpu.io.threadediter import ThreadedIter  # noqa: F401
+from dmlc_core_tpu.io.input_split import InputSplit, create_input_split  # noqa: F401
+from dmlc_core_tpu.io.uri_spec import URISpec  # noqa: F401

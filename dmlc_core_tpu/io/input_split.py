@@ -1,0 +1,960 @@
+"""Sharded multi-file input: deterministic byte-range partitioning with
+record-boundary realignment, plus threaded/cached/shuffled decorators.
+
+This is the data-parallel heart of the reference — "distributed training" in
+dmlc-core *is* this partition math (SURVEY.md §2.9). Capability parity with:
+
+- ``InputSplitBase`` engine (src/io/input_split_base.{h,cc}): ';'-separated
+  multi-file lists with regex glob expansion (ConvertToURIs .cc:95-146),
+  cumulative size table, aligned partition math with record realignment at both
+  shard edges (ResetPartition .cc:29-63), boundary-safe chunk reads with
+  overflow carry (ReadChunk .cc:205-233);
+- ``LineSplitter`` (src/io/line_split.cc), ``RecordIOSplitter``
+  (src/io/recordio_split.cc), ``IndexedRecordIOSplitter``
+  (src/io/indexed_recordio_split.cc), ``SingleFileSplit`` (stdin,
+  src/io/single_file_split.h);
+- ``ThreadedInputSplit`` double-buffered prefetch (src/io/threaded_input_split.h),
+  ``CachedInputSplit`` epoch-cache (src/io/cached_input_split.h),
+  ``InputSplitShuffle`` macro-shuffling (include/dmlc/input_split_shuffle.h);
+- the factory (InputSplit::Create, src/io.cc:63-117).
+
+The invariant that makes partitions disjoint and exhaustive: partition k covers
+aligned byte range [k*nstep, (k+1)*nstep) of the *concatenated* file bytes,
+with each edge moved forward to the next record head **within the file that
+contains it** (file starts are always record heads, so realignment never
+crosses a file boundary).
+
+TPU mapping: per-host input sharding is exactly
+``part_index=jax.process_index(), num_parts=jax.process_count()`` — see
+:mod:`dmlc_core_tpu.bridge`.
+
+Hot-loop note: record/boundary scans are numpy-vectorized here; the C++ native
+core (dmlc_core_tpu/native) accelerates the same entry points when built.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import struct
+import sys
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from dmlc_core_tpu.io import filesys as fsys
+from dmlc_core_tpu.io import recordio as rio
+from dmlc_core_tpu.io.stream import SeekStream, Stream
+from dmlc_core_tpu.io.threadediter import ThreadedIter
+from dmlc_core_tpu.io.uri_spec import URISpec
+from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ, CHECK_LT, CHECK_NE, log_warning
+
+__all__ = [
+    "InputSplit",
+    "InputSplitBase",
+    "LineSplitter",
+    "RecordIOSplitter",
+    "IndexedRecordIOSplitter",
+    "SingleFileSplit",
+    "ThreadedInputSplit",
+    "CachedInputSplit",
+    "InputSplitShuffle",
+    "create_input_split",
+]
+
+# default chunk buffer: 8 MB (reference kBufferSize = 2<<20 uint32 words,
+# src/io/input_split_base.h:40)
+DEFAULT_BUFFER_SIZE = 8 << 20
+
+
+class ChunkCursor:
+    """A consumer-side view over one chunk of bytes, advanced record by record
+    (the reference's Chunk begin/end pointer pair)."""
+
+    __slots__ = ("data", "pos")
+
+    def __init__(self, data: bytes = b""):
+        self.data = data
+        self.pos = len(data) if not data else 0
+
+    def exhausted(self) -> bool:
+        return self.pos >= len(self.data)
+
+
+class InputSplit:
+    """Abstract record input split (reference include/dmlc/io.h:135-280)."""
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def next_record(self) -> Optional[memoryview]:
+        """Next record as a zero-copy view (invalidated by the next call)."""
+        raise NotImplementedError
+
+    def next_chunk(self) -> Optional[bytes]:
+        """Next chunk of whole records, for chunk-parallel parsing."""
+        raise NotImplementedError
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        pass
+
+    def get_total_size(self) -> int:
+        raise NotImplementedError
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __iter__(self):
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec
+
+    # factory — see create_input_split below
+    @staticmethod
+    def create(uri: str, part_index: int, num_parts: int, type: str = "text",
+               **kwargs) -> "InputSplit":
+        return create_input_split(uri, part_index, num_parts, type, **kwargs)
+
+
+class InputSplitBase(InputSplit):
+    """Byte-range sharding engine over a list of files."""
+
+    def __init__(self, fs: fsys.FileSystem, uri: str, align_bytes: int):
+        self._filesys = fs
+        self._align = align_bytes
+        self._files: List[fsys.FileInfo] = []
+        self._init_input_file_info(uri)
+        offsets = [0]
+        for info in self._files:
+            CHECK_EQ(info.size % align_bytes, 0,
+                     f"file {info.path.str()} does not align by {align_bytes} bytes")
+            offsets.append(offsets[-1] + info.size)
+        self._file_offset = offsets
+        self._fs: Optional[SeekStream] = None
+        self._file_ptr = 0
+        self._file_ptr_end = 0
+        self._offset_begin = 0
+        self._offset_end = 0
+        self._offset_curr = 0
+        self._overflow = b""
+        self._buffer_size = DEFAULT_BUFFER_SIZE
+        self._cursor = ChunkCursor()
+
+    # -- file-list expansion (reference ConvertToURIs, .cc:95-146) -----------
+    def _convert_to_uris(self, uri: str) -> List[fsys.URI]:
+        expanded: List[fsys.URI] = []
+        for token in uri.split(";"):
+            if not token:
+                continue
+            path = fsys.URI(token)
+            pos = path.name.rfind("/")
+            if pos < 0 or pos + 1 == len(path.name):
+                expanded.append(path)
+                continue
+            parent = path.copy()
+            parent.name = path.name[:pos]
+            try:
+                dfiles = self._filesys.list_directory(parent)
+            except OSError:
+                expanded.append(path)
+                continue
+            stripped_target = path.name.rstrip("/")
+            exact = [f for f in dfiles if f.path.name.rstrip("/") == stripped_target]
+            if exact:
+                expanded.append(exact[0].path)
+                continue
+            # regex expansion against the directory listing
+            try:
+                pattern = re.compile(path.name)
+            except re.error as exc:
+                from dmlc_core_tpu.utils.logging import log_fatal
+                log_fatal(f"bad regex {path.name!r}: {exc}")
+            for f in dfiles:
+                if f.type != fsys.FileType.FILE or f.size == 0:
+                    continue
+                if pattern.fullmatch(f.path.name.rstrip("/")):
+                    expanded.append(f.path)
+        return expanded
+
+    def _init_input_file_info(self, uri: str) -> None:
+        for path in self._convert_to_uris(uri):
+            info = self._filesys.get_path_info(path)
+            if info.type == fsys.FileType.DIRECTORY:
+                for sub in self._filesys.list_directory(info.path):
+                    if sub.size != 0 and sub.type == fsys.FileType.FILE:
+                        self._files.append(sub)
+            elif info.size != 0:
+                self._files.append(info)
+        CHECK_NE(len(self._files), 0,
+                 f"cannot find any files that match the URI pattern {uri!r}")
+
+    # -- partition math (reference ResetPartition, .cc:29-63) ----------------
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        ntotal = self._file_offset[-1]
+        nstep = (ntotal + num_parts - 1) // num_parts
+        nstep = ((nstep + self._align - 1) // self._align) * self._align
+        self._offset_begin = min(nstep * part_index, ntotal)
+        self._offset_end = min(nstep * (part_index + 1), ntotal)
+        self._offset_curr = self._offset_begin
+        if self._offset_begin == self._offset_end:
+            self._cursor = ChunkCursor()
+            self._overflow = b""
+            return
+        self._file_ptr = self._upper_bound(self._offset_begin)
+        self._file_ptr_end = self._upper_bound(self._offset_end)
+        self._close_fs()
+        # realign the end edge to the next record head inside its file
+        if self._offset_end != self._file_offset[self._file_ptr_end]:
+            fs = self._filesys.open_for_read(self._files[self._file_ptr_end].path)
+            fs.seek(self._offset_end - self._file_offset[self._file_ptr_end])
+            self._offset_end += self.seek_record_begin(fs)
+            fs.close()
+        # realign the begin edge likewise
+        self._fs = self._filesys.open_for_read(self._files[self._file_ptr].path)
+        if self._offset_begin != self._file_offset[self._file_ptr]:
+            self._fs.seek(self._offset_begin - self._file_offset[self._file_ptr])
+            self._offset_begin += self.seek_record_begin(self._fs)
+        self.before_first()
+
+    def _upper_bound(self, offset: int) -> int:
+        """Index of the file containing byte `offset` of the concatenation."""
+        import bisect
+
+        return bisect.bisect_right(self._file_offset, offset) - 1
+
+    def before_first(self) -> None:
+        if self._offset_begin >= self._offset_end:
+            return
+        fp = self._upper_bound(self._offset_begin)
+        if self._fs is None or self._file_ptr != fp:
+            self._close_fs()
+            self._file_ptr = fp
+            self._fs = self._filesys.open_for_read(self._files[fp].path)
+        self._fs.seek(self._offset_begin - self._file_offset[self._file_ptr])
+        self._offset_curr = self._offset_begin
+        self._cursor = ChunkCursor()
+        self._overflow = b""
+
+    def get_total_size(self) -> int:
+        return self._file_offset[-1]
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._buffer_size = max(chunk_size, self._buffer_size)
+
+    def _close_fs(self) -> None:
+        if self._fs is not None:
+            self._fs.close()
+            self._fs = None
+
+    def close(self) -> None:
+        self._close_fs()
+
+    # -- reading (reference Read/ReadChunk, .cc:171-233) ---------------------
+    def read(self, size: int) -> bytes:
+        """Read up to `size` bytes of this partition, crossing file boundaries."""
+        if self._offset_begin >= self._offset_end or self._fs is None:
+            return b""
+        size = min(size, self._offset_end - self._offset_curr)
+        if size == 0:
+            return b""
+        out = bytearray()
+        while len(out) < size:
+            chunk = self._fs.read(size - len(out))
+            if chunk:
+                out.extend(chunk)
+                self._offset_curr += len(chunk)
+                continue
+            CHECK_EQ(self._offset_curr, self._file_offset[self._file_ptr + 1],
+                     "file offset not calculated correctly")
+            if self._file_ptr + 1 >= len(self._files):
+                break
+            self._file_ptr += 1
+            self._close_fs()
+            self._fs = self._filesys.open_for_read(self._files[self._file_ptr].path)
+        return bytes(out)
+
+    def read_chunk(self, max_size: int) -> Optional[bytes]:
+        """One chunk ending at a record boundary.
+
+        Returns None at partition end; b"" when `max_size` is too small to hold
+        one full record (caller grows the buffer — reference's *size=0 signal).
+        """
+        if max_size <= len(self._overflow):
+            return b""
+        head, self._overflow = self._overflow, b""
+        data = head + self.read(max_size - len(head))
+        if not data:
+            return None
+        if len(data) != max_size:
+            return data  # partition tail: ends exactly at the realigned edge
+        cut = self.find_last_record_begin(data)
+        self._overflow = data[cut:]
+        return data[:cut]
+
+    def next_chunk_bytes(self) -> Optional[bytes]:
+        """Next non-empty chunk, growing the buffer for oversized records
+        (reference Chunk::Load, .cc:235-252)."""
+        size = self._buffer_size
+        while True:
+            chunk = self.read_chunk(size)
+            if chunk is None:
+                return None
+            if chunk == b"":
+                size *= 2
+                continue
+            return chunk
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self.next_chunk_bytes()
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            rec = self.extract_next_record(self._cursor)
+            if rec is not None:
+                return rec
+            chunk = self.next_chunk_bytes()
+            if chunk is None:
+                return None
+            self._cursor = ChunkCursor(chunk)
+
+    # -- per-format hooks ----------------------------------------------------
+    def seek_record_begin(self, fs: Stream) -> int:
+        """Bytes to skip from the current position to the next record head."""
+        raise NotImplementedError
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        """Offset of the last record head in `data` (0 if none beyond start)."""
+        raise NotImplementedError
+
+    def extract_next_record(self, cursor: ChunkCursor) -> Optional[memoryview]:
+        raise NotImplementedError
+
+
+class LineSplitter(InputSplitBase):
+    """Record = line (reference src/io/line_split.cc)."""
+
+    def __init__(self, fs: fsys.FileSystem, uri: str, part_index: int, num_parts: int):
+        super().__init__(fs, uri, align_bytes=1)
+        self.reset_partition(part_index, num_parts)
+
+    def seek_record_begin(self, fs: Stream) -> int:
+        # scan to the first end-of-line, then past the newline run
+        # (reference line_split.cc:9-26); over-reading is fine because the
+        # engine re-seeks before reading data.
+        nstep = 0
+        seen_eol = False
+        while True:
+            block = fs.read(4096)
+            if not block:
+                return nstep
+            for b in block:
+                if not seen_eol:
+                    nstep += 1
+                    if b in (0x0A, 0x0D):
+                        seen_eol = True
+                else:
+                    if b in (0x0A, 0x0D):
+                        nstep += 1
+                    else:
+                        return nstep
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        n = max(data.rfind(b"\n"), data.rfind(b"\r"))
+        return n + 1 if n > 0 else 0
+
+    def extract_next_record(self, cursor: ChunkCursor) -> Optional[memoryview]:
+        if cursor.exhausted():
+            return None
+        data, pos = cursor.data, cursor.pos
+        ln = data.find(b"\n", pos)
+        lr = data.find(b"\r", pos)
+        if ln < 0:
+            p = lr if lr >= 0 else len(data)
+        elif lr < 0:
+            p = ln
+        else:
+            p = min(ln, lr)
+        rec = memoryview(data)[pos:p]
+        # skip the newline run (reference line_split.cc:42-45)
+        while p < len(data) and data[p] in (0x0A, 0x0D):
+            p += 1
+        cursor.pos = p
+        return rec
+
+
+class RecordIOSplitter(InputSplitBase):
+    """Record = magic-framed RecordIO blob (reference src/io/recordio_split.cc)."""
+
+    def __init__(self, fs: fsys.FileSystem, uri: str, part_index: int, num_parts: int):
+        super().__init__(fs, uri, align_bytes=4)
+        self.reset_partition(part_index, num_parts)
+
+    def seek_record_begin(self, fs: Stream) -> int:
+        # word-scan for magic followed by cflag 0/1 (reference recordio_split.cc:9-26)
+        nstep = 0
+        pending: bytes = b""
+        saw_magic = False
+        while True:
+            block = pending + fs.read(4096)
+            pending = b""
+            if len(block) < 4:
+                return nstep
+            nwords = len(block) // 4
+            words = np.frombuffer(block, dtype="<u4", count=nwords)
+            i = 0
+            while i < nwords:
+                if saw_magic:
+                    nstep += 4
+                    cflag = rio.decode_flag(int(words[i]))
+                    saw_magic = False
+                    if cflag in (0, 1):
+                        return nstep - 8
+                    i += 1
+                    continue
+                if int(words[i]) == rio.RECORDIO_MAGIC:
+                    nstep += 4
+                    saw_magic = True
+                    i += 1
+                else:
+                    nstep += 4
+                    i += 1
+            pending = block[nwords * 4:]
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        nwords = len(data) // 4
+        if nwords < 2:
+            return 0
+        words = np.frombuffer(data, dtype="<u4", count=nwords)
+        cand = np.nonzero(words[:nwords - 1] == rio.RECORDIO_MAGIC)[0]
+        flags = (words[cand + 1] >> 29) & 7
+        cand = cand[(flags == 0) | (flags == 1)]
+        cand = cand[cand > 0]
+        return int(cand[-1]) * 4 if cand.size else 0
+
+    def extract_next_record(self, cursor: ChunkCursor) -> Optional[memoryview]:
+        if cursor.exhausted():
+            return None
+        data = cursor.data
+        CHECK(cursor.pos + 8 <= len(data), "invalid RecordIO format")
+        magic, lrec = struct.unpack_from("<II", data, cursor.pos)
+        CHECK_EQ(magic, rio.RECORDIO_MAGIC, "invalid RecordIO format")
+        cflag, clen = rio.decode_flag(lrec), rio.decode_length(lrec)
+        start = cursor.pos + 8
+        cursor.pos = start + (((clen + 3) >> 2) << 2)
+        CHECK(cursor.pos <= len(data), "invalid RecordIO format")
+        if cflag == 0:
+            return memoryview(data)[start:start + clen]
+        CHECK_EQ(cflag, 1, "invalid RecordIO format")
+        parts = [bytes(memoryview(data)[start:start + clen])]
+        while cflag != 3:
+            CHECK(cursor.pos + 8 <= len(data), "invalid RecordIO format")
+            magic, lrec = struct.unpack_from("<II", data, cursor.pos)
+            CHECK_EQ(magic, rio.RECORDIO_MAGIC, "invalid RecordIO format")
+            cflag, clen = rio.decode_flag(lrec), rio.decode_length(lrec)
+            start = cursor.pos + 8
+            parts.append(rio._MAGIC_BYTES)
+            parts.append(bytes(memoryview(data)[start:start + clen]))
+            cursor.pos = start + (((clen + 3) >> 2) << 2)
+        return memoryview(b"".join(parts))
+
+
+class IndexedRecordIOSplitter(RecordIOSplitter):
+    """Index-file-driven record partitioning with optional shuffled batches
+    (reference src/io/indexed_recordio_split.cc)."""
+
+    KRAND_MAGIC = 111
+
+    def __init__(self, fs: fsys.FileSystem, uri: str, index_uri: str,
+                 part_index: int, num_parts: int, batch_size: int = 256,
+                 shuffle: bool = False, seed: int = 0):
+        InputSplitBase.__init__(self, fs, uri, align_bytes=4)
+        self._shuffle = shuffle
+        self._rng = random.Random(self.KRAND_MAGIC + seed)
+        self._batch_size = batch_size
+        self._index: List[Tuple[int, int]] = []  # (offset, size) per record batch head
+        self._read_index_file(index_uri)
+        self._permutation: List[int] = []
+        self._current_index = 0
+        self._index_begin = 0
+        self._index_end = 0
+        self._n_overflow = 0
+        self.reset_partition(part_index, num_parts)
+
+    def _read_index_file(self, index_uri: str) -> None:
+        paths = self._convert_to_uris(index_uri)
+        CHECK_EQ(len(paths), 1, "IndexedRecordIOSplitter supports a single index file")
+        stream = self._filesys.open_for_read(paths[0])
+        text = stream.as_file().read().decode("utf-8")
+        stream.close()
+        offsets = sorted(int(tok.split()[1]) for tok in text.splitlines() if tok.strip())
+        CHECK(len(offsets) > 0, "empty index file")
+        total = self._file_offset[-1]
+        for a, b in zip(offsets, offsets[1:] + [total]):
+            self._index.append((a, b - a))
+
+    # record-count-based partitioning (reference .cc:12-41)
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        ntotal = len(self._index)
+        nstep = (ntotal + num_parts - 1) // num_parts
+        if part_index * nstep >= ntotal:
+            self._offset_begin = self._offset_end = 0
+            self._cursor = ChunkCursor()
+            return
+        self._index_begin = part_index * nstep
+        self._offset_begin = self._index[self._index_begin][0]
+        if (part_index + 1) * nstep < ntotal:
+            self._index_end = (part_index + 1) * nstep
+            self._offset_end = self._index[self._index_end][0]
+        else:
+            self._index_end = ntotal
+            self._offset_end = self._file_offset[-1]
+        self._offset_curr = self._offset_begin
+        self._file_ptr = self._upper_bound(self._offset_begin)
+        self._file_ptr_end = self._upper_bound(self._offset_end)
+        self._close_fs()
+        self._fs = self._filesys.open_for_read(self._files[self._file_ptr].path)
+        self._n_overflow = 0
+        self.before_first()
+
+    def before_first(self) -> None:
+        if self._shuffle:
+            self._permutation = list(range(self._index_begin, self._index_end))
+            self._rng.shuffle(self._permutation)
+            self._current_index = 0
+        else:
+            self._current_index = self._index_begin
+        if self._offset_begin < self._offset_end:
+            InputSplitBase.before_first(self)
+
+    def _index_offset_end(self, idx: int) -> int:
+        if idx < len(self._index):
+            return self._index[idx][0]
+        return self._file_offset[-1]
+
+    def _seek_to(self, offset: int) -> None:
+        fp = self._upper_bound(offset)
+        if fp != self._file_ptr or self._fs is None:
+            self._close_fs()
+            self._file_ptr = fp
+            self._fs = self._filesys.open_for_read(self._files[fp].path)
+        self._fs.seek(offset - self._file_offset[fp])
+        self._offset_curr = offset
+
+    def _read_exact_span(self, offset: int, size: int) -> bytes:
+        self._seek_to(offset)
+        saved_end = self._offset_end
+        self._offset_end = max(self._offset_end, offset + size)
+        data = self.read(size)
+        self._offset_end = saved_end
+        return data
+
+    def next_batch_bytes(self, n_records: int) -> Optional[bytes]:
+        """Read the next `n_records` batch as one chunk (reference NextBatchEx)."""
+        if self._shuffle:
+            n = self._n_overflow if self._n_overflow else n_records
+            parts: List[bytes] = []
+            n_read = 0
+            while n_read < n and self._current_index < len(self._permutation):
+                off, size = self._index[self._permutation[self._current_index]]
+                parts.append(self._read_exact_span(off, size))
+                n_read += 1
+                self._current_index += 1
+            if n_read == 0:
+                return None
+            self._n_overflow = n - n_read
+            return b"".join(parts)
+        n = self._n_overflow if self._n_overflow else n_records
+        last = min(self._current_index + n, self._index_end)
+        self._n_overflow = self._current_index + n - last
+        if last == self._current_index:
+            return None
+        begin_off = self._index[self._current_index][0]
+        end_off = self._offset_end if last == self._index_end else self._index[last][0]
+        size = end_off - begin_off
+        self._current_index = last
+        data = self._read_exact_span(begin_off, size)
+        return data if data else None
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self.next_batch_bytes(self._batch_size)
+
+    def next_chunk_bytes(self) -> Optional[bytes]:
+        return self.next_batch_bytes(self._batch_size)
+
+    def next_batch(self, n_records: int) -> Optional[bytes]:
+        return self.next_batch_bytes(n_records)
+
+    def set_batch_size(self, batch_size: int) -> None:
+        self._batch_size = batch_size
+
+    def set_random_seed(self, seed: int) -> None:
+        self._rng = random.Random(self.KRAND_MAGIC + seed)
+
+
+class SingleFileSplit(InputSplit):
+    """Line records from a single file or stdin, no partitioning
+    (reference src/io/single_file_split.h:27-173)."""
+
+    def __init__(self, uri: str):
+        if uri in ("stdin", "-"):
+            self._f = sys.stdin.buffer
+            self._stdin = True
+        else:
+            self._f = open(uri, "rb")
+            self._stdin = False
+        self._cursor = ChunkCursor()
+        self._buffer_size = DEFAULT_BUFFER_SIZE
+        self._eof = False
+
+    def before_first(self) -> None:
+        CHECK(not self._stdin, "cannot rewind stdin")
+        self._f.seek(0)
+        self._cursor = ChunkCursor()
+        self._eof = False
+
+    def get_total_size(self) -> int:
+        if self._stdin:
+            return 0
+        import os
+
+        return os.fstat(self._f.fileno()).st_size
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        CHECK_EQ(num_parts, 1, "SingleFileSplit does not support partitioning")
+        self.before_first()
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._buffer_size = max(chunk_size, self._buffer_size)
+
+    def next_chunk(self) -> Optional[bytes]:
+        if self._eof:
+            return None
+        data = self._f.read(self._buffer_size)
+        if not data:
+            self._eof = True
+            return None
+        if data[-1:] not in (b"\n", b"\r"):
+            # extend to the end of the line
+            extra = bytearray()
+            while True:
+                c = self._f.read(1)
+                if not c:
+                    self._eof = True
+                    break
+                extra += c
+                if c in (b"\n", b"\r"):
+                    break
+            data += bytes(extra)
+        return data
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            rec = LineSplitter.extract_next_record(self, self._cursor)  # type: ignore[arg-type]
+            if rec is not None:
+                return rec
+            chunk = self.next_chunk()
+            if chunk is None:
+                return None
+            self._cursor = ChunkCursor(chunk)
+
+    def close(self) -> None:
+        if not self._stdin:
+            self._f.close()
+
+
+class _ChunkProducer:
+    """ThreadedIter producer yielding chunks from an InputSplitBase."""
+
+    def __init__(self, base: InputSplitBase):
+        self._base = base
+
+    def before_first(self) -> None:
+        self._base.before_first()
+
+    def next(self, reuse):
+        return self._base.next_chunk_bytes()
+
+
+class ThreadedInputSplit(InputSplit):
+    """Double-buffered read-ahead decorator (reference
+    src/io/threaded_input_split.h:23-101; ThreadedIter capacity 2)."""
+
+    def __init__(self, base: InputSplitBase):
+        self._base = base
+        self._iter: ThreadedIter = ThreadedIter(_ChunkProducer(base), max_capacity=2)
+        self._cursor = ChunkCursor()
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+        self._cursor = ChunkCursor()
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._base.hint_chunk_size(chunk_size)
+
+    def get_total_size(self) -> int:
+        return self._base.get_total_size()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        # pause the producer, reshard, restart (reference threaded_input_split.h:55-60)
+        self._iter.destroy()
+        self._base.reset_partition(part_index, num_parts)
+        self._iter = ThreadedIter(_ChunkProducer(self._base), max_capacity=2)
+        self._cursor = ChunkCursor()
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._iter.next()
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            rec = self._base.extract_next_record(self._cursor)
+            if rec is not None:
+                return rec
+            chunk = self._iter.next()
+            if chunk is None:
+                return None
+            self._cursor = ChunkCursor(chunk)
+
+    def close(self) -> None:
+        self._iter.destroy()
+        self._base.close()
+
+
+class CachedInputSplit(InputSplit):
+    """Epoch-1 streams from the source while teeing chunks into a local cache
+    file; later epochs replay the cache (reference src/io/cached_input_split.h)."""
+
+    def __init__(self, base: InputSplitBase, cache_file: str):
+        self._base = base
+        self._cache_file = cache_file
+        self._cursor = ChunkCursor()
+        self._cache_fo = open(cache_file, "wb")
+        self._preproc = True
+        self._iter = ThreadedIter(self._make_preproc_producer(), max_capacity=2)
+
+    def _make_preproc_producer(self):
+        parent = self
+
+        class _Producer:
+            def before_first(self) -> None:
+                parent._base.before_first()
+
+            def next(self, reuse):
+                chunk = parent._base.next_chunk_bytes()
+                if chunk is None:
+                    return None
+                parent._cache_fo.write(struct.pack("<Q", len(chunk)))
+                parent._cache_fo.write(chunk)
+                return chunk
+
+        return _Producer()
+
+    def _make_cache_producer(self):
+        parent = self
+
+        class _Producer:
+            def __init__(self) -> None:
+                self._fi = open(parent._cache_file, "rb")
+
+            def before_first(self) -> None:
+                self._fi.seek(0)
+
+            def next(self, reuse):
+                header = self._fi.read(8)
+                if len(header) < 8:
+                    return None
+                (size,) = struct.unpack("<Q", header)
+                data = self._fi.read(size)
+                CHECK_EQ(len(data), size, "corrupt cache file")
+                return data
+
+        return _Producer()
+
+    def _finish_preproc(self) -> None:
+        # drain the remaining chunks into the cache, then swap producers
+        # (reference cached_input_split.h:63-86)
+        while self._iter.next() is not None:
+            pass
+        self._iter.destroy()
+        self._cache_fo.close()
+        self._base.close()
+        self._preproc = False
+        self._iter = ThreadedIter(self._make_cache_producer(), max_capacity=2)
+
+    def before_first(self) -> None:
+        if self._preproc:
+            self._finish_preproc()
+        else:
+            self._iter.before_first()
+        self._cursor = ChunkCursor()
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._base.hint_chunk_size(chunk_size)
+
+    def get_total_size(self) -> int:
+        return self._base.get_total_size()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        from dmlc_core_tpu.utils.logging import log_fatal
+
+        log_fatal("CachedInputSplit does not support reset_partition; "
+                  "recreate it with the new shard (cache files are per-part)")
+
+    def next_chunk(self) -> Optional[bytes]:
+        chunk = self._iter.next()
+        if chunk is None and self._preproc:
+            # first epoch exhausted: finalize cache so the next epoch replays it
+            self._finish_preproc_tail()
+        return chunk
+
+    def _finish_preproc_tail(self) -> None:
+        if self._preproc:
+            self._iter.destroy()
+            self._cache_fo.close()
+            self._base.close()
+            self._preproc = False
+            self._iter = ThreadedIter(self._make_cache_producer(), max_capacity=2)
+            # leave the new iterator at end-of-epoch state: consume nothing; the
+            # caller's before_first() rewinds it.
+            while self._iter.next() is not None:
+                pass
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            rec = self._base.extract_next_record(self._cursor)
+            if rec is not None:
+                return rec
+            chunk = self.next_chunk()
+            if chunk is None:
+                return None
+            self._cursor = ChunkCursor(chunk)
+
+    def close(self) -> None:
+        self._iter.destroy()
+        if self._preproc:
+            self._cache_fo.close()
+        self._base.close()
+
+
+class InputSplitShuffle(InputSplit):
+    """Macro-shuffle: divide this rank's shard into N sub-parts and visit them
+    in a reshuffled order each epoch (reference include/dmlc/input_split_shuffle.h)."""
+
+    KRAND_MAGIC = 666
+
+    def __init__(self, uri: str, part_index: int, num_parts: int, type: str,
+                 num_shuffle_parts: int, shuffle_seed: int = 0):
+        CHECK(num_shuffle_parts > 0, "number of shuffle parts must be positive")
+        self._part_index = part_index
+        self._num_parts = num_parts
+        self._num_shuffle = num_shuffle_parts
+        self._rng = random.Random(
+            self.KRAND_MAGIC + part_index + num_parts + num_shuffle_parts + shuffle_seed)
+        self._indexes = list(range(num_shuffle_parts))
+        self._rng.shuffle(self._indexes)
+        self._cur = 0
+        idx = self._indexes[0] + part_index * num_shuffle_parts
+        self._source = create_input_split(
+            uri, idx, num_parts * num_shuffle_parts, type)
+
+    @staticmethod
+    def create(uri: str, part_index: int, num_parts: int, type: str,
+               num_shuffle_parts: int, shuffle_seed: int = 0) -> InputSplit:
+        return InputSplitShuffle(uri, part_index, num_parts, type,
+                                 num_shuffle_parts, shuffle_seed)
+
+    def _advance_subpart(self) -> bool:
+        if self._cur == self._num_shuffle - 1:
+            return False
+        self._cur += 1
+        idx = self._indexes[self._cur] + self._part_index * self._num_shuffle
+        self._source.reset_partition(idx, self._num_parts * self._num_shuffle)
+        return True
+
+    def before_first(self) -> None:
+        if self._num_shuffle > 1:
+            self._rng.shuffle(self._indexes)
+            idx = self._indexes[0] + self._part_index * self._num_shuffle
+            self._source.reset_partition(idx, self._num_parts * self._num_shuffle)
+            self._cur = 0
+        else:
+            self._source.before_first()
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._source.hint_chunk_size(chunk_size)
+
+    def get_total_size(self) -> int:
+        return self._source.get_total_size()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        CHECK_EQ(num_parts, self._num_parts, "num_parts is not consistent")
+        self._part_index = part_index
+        idx = self._indexes[0] + part_index * self._num_shuffle
+        self._source.reset_partition(idx, num_parts * self._num_shuffle)
+        self._cur = 0
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            rec = self._source.next_record()
+            if rec is not None:
+                return rec
+            if not self._advance_subpart():
+                return None
+
+    def next_chunk(self) -> Optional[bytes]:
+        while True:
+            chunk = self._source.next_chunk()
+            if chunk is not None:
+                return chunk
+            if not self._advance_subpart():
+                return None
+
+    def close(self) -> None:
+        self._source.close()
+
+
+def create_input_split(
+    uri: str,
+    part_index: int,
+    num_parts: int,
+    type: str = "text",
+    index_uri: Optional[str] = None,
+    shuffle: bool = False,
+    seed: int = 0,
+    batch_size: int = 256,
+    threaded: bool = True,
+) -> InputSplit:
+    """Factory (reference InputSplit::Create, src/io.cc:63-117).
+
+    Supports the URI sugar ``path?k=v#cachefile``; "stdin" or "-" gives a
+    :class:`SingleFileSplit`.  ``type`` is "text", "recordio", or
+    "indexed_recordio" (requires ``index_uri``).
+    """
+    spec = URISpec(uri, part_index, num_parts)
+    if spec.uri in ("stdin", "-"):
+        return SingleFileSplit(spec.uri)
+    CHECK_LT(part_index, num_parts, "invalid input parameters for create_input_split")
+    path = fsys.URI(spec.uri)
+    fs = fsys.get_filesystem(path)
+    if type == "text":
+        split: InputSplitBase = LineSplitter(fs, spec.uri, part_index, num_parts)
+    elif type == "recordio":
+        split = RecordIOSplitter(fs, spec.uri, part_index, num_parts)
+    elif type == "indexed_recordio":
+        CHECK(index_uri is not None, "need an index file to use indexed_recordio")
+        index_spec = URISpec(index_uri, part_index, num_parts)
+        split = IndexedRecordIOSplitter(fs, spec.uri, index_spec.uri, part_index,
+                                        num_parts, batch_size, shuffle, seed)
+    else:
+        from dmlc_core_tpu.utils.logging import log_fatal
+
+        log_fatal(f"unknown input split type {type!r}")
+    if spec.cache_file:
+        return CachedInputSplit(split, spec.cache_file)
+    if threaded:
+        return ThreadedInputSplit(split)
+    return split

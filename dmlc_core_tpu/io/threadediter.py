@@ -1,0 +1,206 @@
+"""Threaded producer/consumer iterator — the universal pipeline primitive.
+
+Capability parity with the reference's ``dmlc::ThreadedIter<DType>``
+(include/dmlc/threadediter.h:45-394): a single producer thread fills a bounded
+queue; the consumer pulls with :meth:`next` and hands buffers back with
+:meth:`recycle` so the producer can reuse them (free-cell recycling,
+threadediter.h:359-394); :meth:`before_first` restarts the epoch
+(kBeforeFirst signal, threadediter.h:167-190) and :meth:`destroy` tears the
+thread down (kDestroy).
+
+Producer protocol (reference Producer subclass, threadediter.h:87-134)::
+
+    class MyProducer:
+        def before_first(self):   # reset to the beginning (optional)
+        def next(self, reuse):    # return next item, reusing `reuse` (may be
+                                  # None) as scratch; return None at the end
+
+Exceptions raised by the producer are captured and re-raised on the consumer
+side at the next :meth:`next` call, matching the reference's exception-ferrying
+(threadediter.h:300-356).
+
+TPU note: this is the host-side prefetch idiom. The device-facing recast of the
+same pattern (double-buffered ``device_put`` against a mesh) lives in
+:mod:`dmlc_core_tpu.bridge.loader`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Generic, Iterator, Optional, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["ThreadedIter", "IteratorProducer"]
+
+_END = object()
+
+
+class IteratorProducer:
+    """Adapts a factory of plain Python iterables to the producer protocol."""
+
+    def __init__(self, factory: Callable[[], Any]):
+        self._factory = factory
+        self._it: Optional[Iterator] = None
+
+    def before_first(self) -> None:
+        self._it = None
+
+    def next(self, reuse: Any) -> Any:
+        if self._it is None:
+            self._it = iter(self._factory())
+        try:
+            return next(self._it)
+        except StopIteration:
+            return None
+
+
+class ThreadedIter(Generic[T]):
+    """Single-producer bounded-queue prefetch iterator."""
+
+    def __init__(self, producer: Any = None, max_capacity: int = 8):
+        self._cap = max(1, int(max_capacity))
+        self._cond = threading.Condition()
+        self._queue: deque = deque()      # (generation, item-or-_END)
+        self._free: deque = deque()       # recycled buffers
+        self._gen = 0                     # current consumer generation
+        self._destroyed = False
+        self._error: Optional[BaseException] = None
+        self._producer = None
+        self._thread: Optional[threading.Thread] = None
+        if producer is not None:
+            self.init(producer)
+
+    @classmethod
+    def from_factory(cls, factory: Callable[[], Any], max_capacity: int = 8) -> "ThreadedIter":
+        """ThreadedIter over ``iter(factory())`` per epoch."""
+        return cls(IteratorProducer(factory), max_capacity=max_capacity)
+
+    def init(self, producer: Any) -> None:
+        assert self._thread is None, "ThreadedIter already initialized"
+        self._producer = producer
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="dmlc-threadediter")
+        self._thread.start()
+
+    # -- producer thread ------------------------------------------------------
+    def _run(self) -> None:
+        cur_gen = 0
+        need_reset = False
+        while True:
+            if need_reset:
+                try:
+                    self._producer.before_first()
+                except BaseException as exc:  # noqa: BLE001 - ferried to consumer
+                    self._post_error(cur_gen, exc)
+                    return
+            finished = self._produce_epoch(cur_gen)
+            if finished is None:
+                return  # destroyed
+            # epoch over (EOF or reset): wait for the next generation
+            with self._cond:
+                while not self._destroyed and self._gen == cur_gen:
+                    self._cond.wait()
+                if self._destroyed:
+                    return
+                cur_gen = self._gen
+            need_reset = True
+
+    def _produce_epoch(self, cur_gen: int) -> Optional[bool]:
+        """Produce items for ``cur_gen`` until EOF/reset. None means destroyed."""
+        while True:
+            with self._cond:
+                while (len(self._queue) >= self._cap and not self._destroyed
+                       and self._gen == cur_gen):
+                    self._cond.wait()
+                if self._destroyed:
+                    return None
+                if self._gen != cur_gen:
+                    return True  # reset requested mid-epoch
+                reuse = self._free.popleft() if self._free else None
+            try:
+                item = self._producer.next(reuse)
+            except BaseException as exc:  # noqa: BLE001
+                self._post_error(cur_gen, exc)
+                return None
+            with self._cond:
+                if self._destroyed:
+                    return None
+                if self._gen != cur_gen:
+                    return True
+                self._queue.append((cur_gen, _END if item is None else item))
+                self._cond.notify_all()
+                if item is None:
+                    return True
+
+    def _post_error(self, gen: int, exc: BaseException) -> None:
+        with self._cond:
+            self._error = exc
+            self._queue.append((gen, _END))
+            self._cond.notify_all()
+
+    # -- consumer side ---------------------------------------------------------
+    def next(self) -> Optional[T]:
+        """Next item, or None at end of the current epoch (reference Next)."""
+        with self._cond:
+            while True:
+                if self._destroyed:
+                    return None
+                # drop items from stale generations, recycling their buffers
+                while self._queue and self._queue[0][0] != self._gen:
+                    _, item = self._queue.popleft()
+                    if item is not _END:
+                        self._free.append(item)
+                    self._cond.notify_all()
+                if self._queue:
+                    gen, item = self._queue[0]
+                    if item is _END:
+                        if self._error is not None:
+                            err, self._error = self._error, None
+                            self._queue.popleft()
+                            raise err
+                        return None  # leave _END queued: epoch stays "ended"
+                    self._queue.popleft()
+                    self._cond.notify_all()
+                    return item
+                self._cond.wait()
+
+    def recycle(self, item: T) -> None:
+        """Return a consumed buffer for producer reuse (reference Recycle)."""
+        with self._cond:
+            self._free.append(item)
+            self._cond.notify_all()
+
+    def before_first(self) -> None:
+        """Restart from the beginning (reference BeforeFirst signal protocol)."""
+        with self._cond:
+            self._gen += 1
+            # drop everything already queued
+            while self._queue:
+                _, item = self._queue.popleft()
+                if item is not _END:
+                    self._free.append(item)
+            self._cond.notify_all()
+
+    def destroy(self) -> None:
+        with self._cond:
+            if self._destroyed:
+                return
+            self._destroyed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+    def __iter__(self) -> Iterator[T]:
+        while True:
+            item = self.next()
+            if item is None:
+                return
+            yield item

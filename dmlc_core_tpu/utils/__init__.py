@@ -1,0 +1,17 @@
+"""Core utility substrate (reference: include/dmlc/{logging,timer,common}.h)."""
+
+from dmlc_core_tpu.utils.logging import (  # noqa: F401
+    Error,
+    LOG,
+    CHECK,
+    CHECK_EQ,
+    CHECK_NE,
+    CHECK_LT,
+    CHECK_GT,
+    CHECK_LE,
+    CHECK_GE,
+    CHECK_NOTNULL,
+    set_log_sink,
+)
+from dmlc_core_tpu.utils.common import split_string, hash_combine  # noqa: F401
+from dmlc_core_tpu.utils.timer import get_time  # noqa: F401

@@ -1,0 +1,26 @@
+"""Wall-clock timer (reference: include/dmlc/timer.h:27-46).
+
+On TPU, timing device work additionally requires ``jax.block_until_ready`` —
+see :func:`device_time` — because dispatch is asynchronous.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["get_time", "device_time"]
+
+
+def get_time() -> float:
+    """Seconds since epoch at the highest available resolution."""
+    return time.perf_counter()
+
+
+def device_time(fn, *args, **kwargs):
+    """Run ``fn`` and block on its jax outputs; return (result, elapsed_seconds)."""
+    import jax
+
+    start = time.perf_counter()
+    out = fn(*args, **kwargs)
+    out = jax.block_until_ready(out)
+    return out, time.perf_counter() - start

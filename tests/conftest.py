@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax import.
+
+Multi-chip hardware is not available in CI; sharding tests run on
+``--xla_force_host_platform_device_count=8`` as the SURVEY.md §4 test strategy
+prescribes (the "fake cluster" the reference never had).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,64 @@
+"""Config parser tests (reference: test/unittest/unittest_config.cc:13-101)."""
+
+import io
+
+import pytest
+
+from dmlc_core_tpu.config import Config
+from dmlc_core_tpu.utils.logging import Error
+
+
+def test_basics():
+    cfg = Config('k1 = 1243\nk2=  okay\n k3 = "a ok" # comment\n# full comment\nk4 = 1e-4')
+    assert cfg.get_param("k1") == "1243"
+    assert cfg.get_param("k2") == "okay"
+    assert cfg.get_param("k3") == "a ok"
+    assert cfg.get_param("k4") == "1e-4"
+    assert [k for k, _ in cfg.items()] == ["k1", "k2", "k3", "k4"]
+
+
+def test_escapes():
+    cfg = Config('msg = "line1\\nline2\\ttabbed \\"quoted\\""')
+    assert cfg.get_param("msg") == 'line1\nline2\ttabbed "quoted"'
+    # writer restores escaping
+    assert '\\n' in cfg.to_proto_string()
+
+
+def test_overwrite_vs_multi_value():
+    text = "k = 1\nk = 2\n"
+    single = Config(text)
+    assert single.get_param("k") == "2"
+    assert len(list(single.items())) == 1
+
+    multi = Config(text, multi_value=True)
+    assert multi.get_param("k") == "2"
+    assert [v for _, v in multi.items()] == ["1", "2"]
+
+
+def test_set_param_and_order():
+    cfg = Config()
+    cfg.set_param("b", 2)
+    cfg.set_param("a", 1)
+    cfg.set_param("b", 3)
+    assert [(k, v) for k, v in cfg.items()] == [("b", "3"), ("a", "1")]
+
+
+def test_proto_string():
+    cfg = Config('x = 10\nname = "hi there"')
+    proto = cfg.to_proto_string()
+    assert "x : 10\n" in proto
+    assert 'name : "hi there"\n' in proto
+
+
+def test_stream_input():
+    cfg = Config(io.StringIO("k = v\n"))
+    assert cfg.get_param("k") == "v"
+
+
+def test_errors():
+    with pytest.raises(Error):
+        Config('k = "unterminated')
+    with pytest.raises(Error):
+        Config("k =")   # missing value
+    with pytest.raises(Error):
+        Config("= v")   # stray =

@@ -1,0 +1,86 @@
+"""Stream/filesystem tests (reference: test/iostream_test.cc, test/filesys_test.cc)."""
+
+import pytest
+
+from dmlc_core_tpu.io import filesys as fsys
+from dmlc_core_tpu.io.stream import create_stream, create_stream_for_read
+from dmlc_core_tpu.utils.logging import Error
+
+
+def test_uri_parse():
+    u = fsys.URI("hdfs://namenode:9000/path/to/file")
+    assert u.protocol == "hdfs://"
+    assert u.host == "namenode:9000"
+    assert u.name == "/path/to/file"
+    v = fsys.URI("/plain/path.txt")
+    assert v.protocol == "file://"
+    assert v.name == "/plain/path.txt"
+    assert v.str() == "/plain/path.txt"
+    w = fsys.URI("s3://bucket/key/a.txt")
+    assert w.protocol == "s3://" and w.host == "bucket" and w.name == "/key/a.txt"
+
+
+def test_local_roundtrip(tmp_path):
+    path = str(tmp_path / "x.bin")
+    with create_stream(path, "w") as s:
+        s.write(b"hello ")
+        s.write(b"world")
+    with create_stream(path, "r") as s:
+        assert s.read(100) == b"hello world"
+    with create_stream(path, "a") as s:
+        s.write(b"!")
+    fo = create_stream_for_read(path)
+    assert fo.read(100) == b"hello world!"
+    fo.seek(6)
+    assert fo.read(5) == b"world"
+    assert fo.tell() == 11
+    fo.close()
+
+
+def test_typed_io(tmp_path):
+    path = str(tmp_path / "typed.bin")
+    with create_stream(path, "w") as s:
+        s.write_u32(7)
+        s.write_u64(1 << 40)
+        s.write_f64(2.5)
+        s.write_string("hello")
+    with create_stream(path, "r") as s:
+        assert s.read_u32() == 7
+        assert s.read_u64() == 1 << 40
+        assert s.read_f64() == 2.5
+        assert s.read_string() == b"hello"
+
+
+def test_iostream_adapter(tmp_path):
+    """The reference's ostream/istream adapters (test/iostream_test.cc)."""
+    path = str(tmp_path / "lines.txt")
+    with create_stream(path, "w") as s:
+        f = s.as_file()
+        f.write(b"line one\n")
+        f.write(b"line two\n")
+    with create_stream(path, "r") as s:
+        lines = list(s.as_file())
+    assert lines == [b"line one\n", b"line two\n"]
+
+
+def test_path_info_and_listing(tmp_path):
+    (tmp_path / "a.txt").write_bytes(b"123")
+    (tmp_path / "sub").mkdir()
+    fs = fsys.LocalFileSystem()
+    info = fs.get_path_info(fsys.URI(str(tmp_path / "a.txt")))
+    assert info.size == 3 and info.type == fsys.FileType.FILE
+    entries = fs.list_directory(fsys.URI(str(tmp_path)))
+    names = {e.path.name.rsplit("/", 1)[-1]: e.type for e in entries}
+    assert names["a.txt"] == fsys.FileType.FILE
+    assert names["sub"] == fsys.FileType.DIRECTORY
+
+
+def test_unknown_protocol_raises():
+    with pytest.raises(Error, match="unknown filesystem protocol"):
+        fsys.get_filesystem(fsys.URI("bogus://x/y"))
+
+
+def test_allow_null(tmp_path):
+    assert create_stream(str(tmp_path / "missing"), "r", allow_null=True) is None
+    with pytest.raises(OSError):
+        create_stream(str(tmp_path / "missing"), "r")

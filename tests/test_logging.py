@@ -1,0 +1,66 @@
+"""Logging/CHECK tests (reference: test/unittest/unittest_logging.cc, test/logging_test.cc)."""
+
+import pytest
+
+from dmlc_core_tpu.utils import logging as L
+
+
+def test_check_pass_and_fail():
+    L.CHECK(True)
+    L.CHECK_EQ(1, 1)
+    L.CHECK_NE(1, 2)
+    L.CHECK_LT(1, 2)
+    L.CHECK_GT(2, 1)
+    L.CHECK_LE(1, 1)
+    L.CHECK_GE(1, 1)
+    with pytest.raises(L.Error, match="Check failed"):
+        L.CHECK(False, "boom")
+    with pytest.raises(L.Error, match="=="):
+        L.CHECK_EQ(1, 2)
+    with pytest.raises(L.Error):
+        L.CHECK_NOTNULL(None)
+    assert L.CHECK_NOTNULL(5) == 5
+
+
+def test_fatal_raises_with_stack():
+    with pytest.raises(L.Error, match="Stack trace"):
+        L.LOG(L.FATAL, "fatal message")
+
+
+def test_sink_redirect():
+    captured = []
+    L.set_log_sink(lambda sev, line: captured.append((sev, line)))
+    try:
+        L.log_info("hello sink")
+        L.log_warning("warn sink")
+    finally:
+        L.set_log_sink(None)
+    assert captured[0][0] == L.INFO and "hello sink" in captured[0][1]
+    assert captured[1][0] == L.WARNING
+    # file:line of the *caller* is embedded
+    assert "test_logging.py" in captured[0][1]
+
+
+def test_stream_style_message():
+    captured = []
+    L.set_log_sink(lambda sev, line: captured.append(line))
+    try:
+        msg = L.LogMessage(L.INFO)
+        msg << "x=" << 42
+        msg.flush()
+    finally:
+        L.set_log_sink(None)
+    assert "x=42" in captured[0]
+
+
+def test_log_debug_gated(monkeypatch):
+    captured = []
+    L.set_log_sink(lambda sev, line: captured.append(line))
+    try:
+        monkeypatch.setenv("DMLC_LOG_DEBUG", "0")
+        L.log_debug(1, "hidden")
+        monkeypatch.setenv("DMLC_LOG_DEBUG", "2")
+        L.log_debug(1, "shown")
+    finally:
+        L.set_log_sink(None)
+    assert len(captured) == 1 and "shown" in captured[0]

@@ -1,0 +1,123 @@
+"""Parameter system tests (reference: test/parameter_test.cc, doc/parameter.md)."""
+
+import json
+import os
+
+import pytest
+
+from dmlc_core_tpu.param import Parameter, ParamError, field, get_env
+
+
+class MyParam(Parameter):
+    num_hidden = field(int, help="number of hidden units")  # required
+    learning_rate = field(float, default=0.01, lower=0.0, upper=1.0, help="step size")
+    name = field(str, default="layer", help="layer name")
+    act = field(str, default="relu", enum=["relu", "tanh", "sigmoid"], help="activation")
+    use_bias = field(bool, default=True, help="whether to use bias")
+    seed = field(int, optional=True, help="optional RNG seed")
+
+
+def test_init_basic():
+    p = MyParam()
+    p.init({"num_hidden": 100, "learning_rate": "0.1"})
+    assert p.num_hidden == 100
+    assert p.learning_rate == pytest.approx(0.1)
+    assert p.name == "layer"
+    assert p.use_bias is True
+    assert p.seed is None
+
+
+def test_required_missing():
+    with pytest.raises(ParamError, match="num_hidden"):
+        MyParam().init({})
+
+
+def test_unknown_strict_and_allow():
+    p = MyParam()
+    with pytest.raises(ParamError, match="batch"):
+        p.init({"num_hidden": 1, "batch": 5})
+    unknown = p.init({"num_hidden": 1, "batch": 5}, allow_unknown=True)
+    assert unknown == {"batch": 5}
+    # hidden __key__ args always ignored (reference hidden-arg policy)
+    assert p.init({"num_hidden": 1, "__secret__": "x"}) == {}
+
+
+def test_range_check():
+    p = MyParam()
+    with pytest.raises(ParamError, match="exceeds bound"):
+        p.init({"num_hidden": 1, "learning_rate": 2.0})
+    with pytest.raises(ParamError, match="exceeds bound"):
+        p.init({"num_hidden": 1, "learning_rate": -0.5})
+
+
+def test_enum_check():
+    p = MyParam()
+    with pytest.raises(ParamError, match="act"):
+        p.init({"num_hidden": 1, "act": "gelu"})
+    p.init({"num_hidden": 1, "act": "tanh"})
+    assert p.act == "tanh"
+
+
+def test_enum_int_map():
+    class P(Parameter):
+        mode = field(int, default=0, enum={"dense": 0, "sparse": 1})
+
+    p = P()
+    p.init({"mode": "sparse"})
+    assert p.mode == 1
+    assert p.to_dict()["mode"] == "sparse"
+
+
+def test_bool_parsing():
+    p = MyParam()
+    p.init({"num_hidden": 1, "use_bias": "false"})
+    assert p.use_bias is False
+    p.init({"num_hidden": 1, "use_bias": "1"})
+    assert p.use_bias is True
+    with pytest.raises(ParamError):
+        p.init({"num_hidden": 1, "use_bias": "maybe"})
+
+
+def test_bad_type():
+    with pytest.raises(ParamError, match="num_hidden"):
+        MyParam().init({"num_hidden": "abc"})
+    with pytest.raises(ParamError, match="num_hidden"):
+        MyParam().init({"num_hidden": 1.5})
+
+
+def test_json_roundtrip():
+    p = MyParam()
+    p.init({"num_hidden": 7, "act": "sigmoid", "seed": 42})
+    text = p.to_json()
+    q = MyParam()
+    q.load_json(text)
+    assert q == p
+    assert json.loads(text)["num_hidden"] == "7"
+
+
+def test_doc_string_and_field_info():
+    doc = MyParam.doc_string()
+    assert "num_hidden" in doc and "number of hidden units" in doc
+    info = dict((n, (t, h)) for n, t, h in MyParam.get_field_info())
+    assert "required" in info["num_hidden"][0]
+    assert "range [0.0, 1.0]" in info["learning_rate"][0]
+
+
+def test_update_partial():
+    p = MyParam()
+    p.init({"num_hidden": 3})
+    p.update({"learning_rate": 0.5, "nonexistent": 1})
+    assert p.learning_rate == pytest.approx(0.5)
+
+
+def test_kwargs_constructor():
+    p = MyParam(num_hidden=5)
+    assert p.num_hidden == 5
+
+
+def test_get_env():
+    os.environ["DMLC_TEST_ENV_X"] = "32"
+    assert get_env("DMLC_TEST_ENV_X", int, 0) == 32
+    assert get_env("DMLC_TEST_ENV_MISSING", int, 7) == 7
+    os.environ["DMLC_TEST_ENV_B"] = "true"
+    assert get_env("DMLC_TEST_ENV_B", bool, False) is True

@@ -1,0 +1,45 @@
+"""Registry tests (reference: test/registry_test.cc)."""
+
+import pytest
+
+from dmlc_core_tpu.registry import Registry
+
+
+def test_register_find_call():
+    reg = Registry.get("test_tree")
+
+    @reg.register("binary", aliases=["bt"], description="binary tree")
+    def make_binary(depth):
+        return ("binary", depth)
+
+    assert reg.find("binary") is not None
+    assert reg.find("bt") is reg.find("binary")
+    assert reg["binary"](3) == ("binary", 3)
+    assert reg.find("missing") is None
+    assert "binary" in reg
+    assert reg.list_names() == ["binary"]
+    reg.remove("binary")
+    assert reg.find("bt") is None
+
+
+def test_singleton_per_kind():
+    assert Registry.get("kind_a") is Registry.get("kind_a")
+    assert Registry.get("kind_a") is not Registry.get("kind_b")
+
+
+def test_double_registration_raises():
+    reg = Registry.get("test_dup")
+    reg.add("x", lambda: 1)
+    with pytest.raises(KeyError):
+        reg.add("x", lambda: 2)
+    reg.add("x", lambda: 3, override=True)
+    assert reg["x"]() == 3
+    reg.remove("x")
+
+
+def test_unknown_lookup_message():
+    reg = Registry.get("test_msg")
+    reg.add("known", lambda: 1)
+    with pytest.raises(KeyError, match="known"):
+        reg["unknown"]
+    reg.remove("known")
