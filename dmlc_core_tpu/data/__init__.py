@@ -1,0 +1,14 @@
+"""ML data layer: CSR RowBlock batches, text/binary parsers, row iterators.
+
+Reference: include/dmlc/data.h, src/data/ (the sparse-batch data model feeding
+XGBoost/MXNet).  TPU-first recast: RowBlocks are numpy structure-of-arrays on
+the host; :mod:`dmlc_core_tpu.bridge` turns them into mesh-placed jax.Arrays.
+"""
+
+from dmlc_core_tpu.data.row_block import Row, RowBlock, RowBlockContainer  # noqa: F401
+from dmlc_core_tpu.data.parser import Parser, ParserImpl, ThreadedParser  # noqa: F401
+from dmlc_core_tpu.data.libsvm_parser import LibSVMParser  # noqa: F401
+from dmlc_core_tpu.data.libfm_parser import LibFMParser  # noqa: F401
+from dmlc_core_tpu.data.csv_parser import CSVParser, CSVParserParam  # noqa: F401
+from dmlc_core_tpu.data.iterators import BasicRowIter, DiskRowIter  # noqa: F401
+from dmlc_core_tpu.data.factory import create_parser, create_row_block_iter  # noqa: F401

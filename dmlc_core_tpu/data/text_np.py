@@ -1,0 +1,65 @@
+"""Shared numpy-vectorized text tokenization for the parsers.
+
+The reference's hot loop is hand-rolled char scanning + ``strtof``
+(src/data/strtonum.h:37-300).  The Python-side equivalent vectorizes at the
+chunk level: C-speed ``bytes.split`` tokenization, one numpy ``S``-dtype array
+per chunk, and bulk ``astype`` float/int conversion (numpy's C parser).  The
+optional native core (dmlc_core_tpu/native) replaces this wholesale.
+"""
+
+from __future__ import annotations
+
+from itertools import chain
+from typing import List, Tuple
+
+import numpy as np
+
+from dmlc_core_tpu.utils.logging import CHECK
+
+__all__ = ["tokenize_ws", "split_tokens_at_colon"]
+
+
+def tokenize_ws(data: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """Whitespace-tokenize all non-empty lines of `data`.
+
+    Returns ``(tokens, counts)``: a 1-d S-dtype array of every token in order,
+    and the per-line token counts (empty lines dropped — the reference skips
+    them, libsvm_parser.h:53-57).
+    """
+    tok_lists: List[list] = [l.split() for l in data.splitlines()]
+    tok_lists = [t for t in tok_lists if t]
+    if not tok_lists:
+        return np.empty(0, dtype="S1"), np.empty(0, dtype=np.int64)
+    counts = np.fromiter((len(t) for t in tok_lists), np.int64, len(tok_lists))
+    flat = list(chain.from_iterable(tok_lists))
+    return np.array(flat), counts
+
+
+def split_tokens_at_colon(tokens: np.ndarray):
+    """Partition each token at its first ``:``.
+
+    Returns ``(head, has_colon, tail)`` where ``head``/``tail`` are S-dtype
+    arrays (tail is b"" when no colon).
+    """
+    if tokens.size == 0:
+        empty = np.empty(0, dtype="S1")
+        return empty, np.empty(0, dtype=bool), empty
+    part = np.char.partition(tokens, b":")
+    return part[:, 0], part[:, 1] == b":", part[:, 2]
+
+
+def parse_floats(tokens: np.ndarray, what: str) -> np.ndarray:
+    """Bulk str->float32 (the strtof analog); raises with context on garbage."""
+    try:
+        return tokens.astype(np.float32)
+    except ValueError as exc:
+        raise ValueError(f"invalid {what} in input: {exc}") from None
+
+
+def parse_ints(tokens: np.ndarray, dtype, what: str) -> np.ndarray:
+    """Bulk str->integer index (the strtoint analog)."""
+    try:
+        # S->int via float is lossy for huge ids; go through int64 directly
+        return tokens.astype(np.int64).astype(dtype)
+    except ValueError as exc:
+        raise ValueError(f"invalid {what} in input: {exc}") from None

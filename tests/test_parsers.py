@@ -1,0 +1,175 @@
+"""Parser tests (reference: test/libsvm_parser_test.cc, libfm_parser_test.cc,
+csv_parser_test.cc, dataiter_test.cc, strtonum_test.cc)."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data import strtonum
+from dmlc_core_tpu.data.factory import create_parser, create_row_block_iter
+from dmlc_core_tpu.data.iterators import BasicRowIter, DiskRowIter
+
+
+LIBSVM = b"""1 0:1.5 3:2.0
+0 1:1.0
+1
+0 2:0.5 4:0.25 5:1
+"""
+
+LIBSVM_WEIGHTED = b"""1:2.0 0:1.5
+0:0.5 1:1.0
+"""
+
+LIBSVM_NOVALS = b"""1 3 5 7
+0 2
+"""
+
+LIBFM = b"""1 0:0:1.5 1:3:2.0
+0:0.25 2:1:1.0
+"""
+
+CSV = b"""1.0,2.0,3.0
+4.0,5.0,6.0
+"""
+
+
+def write(tmp_path, name, data):
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+def all_rows(parser):
+    rows = []
+    for block in parser:
+        rows.extend(block.rows())
+    return rows
+
+
+def test_libsvm_basic(tmp_path):
+    uri = write(tmp_path, "a.libsvm", LIBSVM)
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    rows = all_rows(parser)
+    assert len(rows) == 4
+    assert rows[0].label == 1.0
+    assert rows[0].index.tolist() == [0, 3]
+    assert rows[0].value.tolist() == [1.5, 2.0]
+    assert rows[2].length == 0
+    assert rows[3].index.tolist() == [2, 4, 5]
+    assert parser.bytes_read() > 0
+
+
+def test_libsvm_weights(tmp_path):
+    uri = write(tmp_path, "w.libsvm", LIBSVM_WEIGHTED)
+    rows = all_rows(create_parser(uri, type="libsvm", threaded=False))
+    assert rows[0].label == 1.0
+    assert rows[0].get_weight() == 2.0
+    assert rows[1].get_weight() == 0.5
+
+
+def test_libsvm_no_values(tmp_path):
+    uri = write(tmp_path, "nv.libsvm", LIBSVM_NOVALS)
+    rows = all_rows(create_parser(uri, type="libsvm", threaded=False))
+    assert rows[0].index.tolist() == [3, 5, 7]
+    assert rows[0].value is None
+    assert rows[0].get_value(0) == 1.0
+
+
+def test_libsvm_threaded_matches(tmp_path):
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(5000):
+        nnz = rng.randint(1, 10)
+        idx = sorted(rng.choice(100, size=nnz, replace=False))
+        feats = " ".join(f"{j}:{rng.rand():.4f}" for j in idx)
+        lines.append(f"{i % 2} {feats}")
+    data = ("\n".join(lines) + "\n").encode()
+    uri = write(tmp_path, "big.libsvm", data)
+    plain = all_rows(create_parser(uri, type="libsvm", threaded=False))
+    threaded = all_rows(create_parser(uri, type="libsvm", threaded=True))
+    assert len(plain) == len(threaded) == 5000
+    for a, b in zip(plain, threaded):
+        assert a.label == b.label
+        assert a.index.tolist() == b.index.tolist()
+
+
+def test_libfm(tmp_path):
+    uri = write(tmp_path, "a.libfm", LIBFM)
+    rows = all_rows(create_parser(uri, type="libfm", threaded=False))
+    assert rows[0].field.tolist() == [0, 1]
+    assert rows[0].index.tolist() == [0, 3]
+    assert rows[0].value.tolist() == [1.5, 2.0]
+    assert rows[1].get_weight() == 0.25
+    assert rows[1].field.tolist() == [2]
+
+
+def test_csv(tmp_path):
+    uri = write(tmp_path, "a.csv", CSV)
+    rows = all_rows(create_parser(uri + "?format=csv", threaded=False))
+    assert rows[0].label == 0.0
+    assert rows[0].value.tolist() == [1.0, 2.0, 3.0]
+    assert rows[0].index.tolist() == [0, 1, 2]
+
+
+def test_csv_label_column(tmp_path):
+    uri = write(tmp_path, "b.csv", CSV)
+    rows = all_rows(create_parser(uri + "?format=csv&label_column=1", threaded=False))
+    assert rows[0].label == 2.0
+    assert rows[0].value.tolist() == [1.0, 3.0]
+    assert rows[1].label == 5.0
+
+
+def test_format_autodetect_default_libsvm(tmp_path):
+    uri = write(tmp_path, "c.txt", LIBSVM)
+    rows = all_rows(create_parser(uri, threaded=False))
+    assert len(rows) == 4
+
+
+def test_parser_sharding_covers_all(tmp_path):
+    lines = b"".join(b"%d 0:%d\n" % (i % 2, i) for i in range(1000))
+    uri = write(tmp_path, "shard.libsvm", lines)
+    values = []
+    for part in range(4):
+        parser = create_parser(uri, part, 4, type="libsvm", threaded=False)
+        for block in parser:
+            values.extend(int(v) for v in block.value)
+    assert sorted(values) == list(range(1000))
+
+
+def test_basic_row_iter(tmp_path):
+    uri = write(tmp_path, "d.libsvm", LIBSVM)
+    it = create_row_block_iter(uri, type="libsvm")
+    assert isinstance(it, BasicRowIter)
+    blocks = list(it)
+    assert len(blocks) == 1 and blocks[0].size == 4
+    it.before_first()
+    assert len(list(it)) == 1
+
+
+def test_disk_row_iter(tmp_path):
+    uri = write(tmp_path, "e.libsvm", LIBSVM)
+    cache = tmp_path / "e.cache"
+    it = create_row_block_iter(f"{uri}#{cache}", type="libsvm")
+    assert isinstance(it, DiskRowIter)
+    rows1 = [r for b in it for r in b.rows()]
+    assert len(rows1) == 4
+    it.before_first()
+    rows2 = [r for b in it for r in b.rows()]
+    assert len(rows2) == 4
+    assert cache.exists()
+    it.close()
+
+
+def test_bad_input_raises(tmp_path):
+    uri = write(tmp_path, "bad.libsvm", b"1 abc:def\n")
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    with pytest.raises(ValueError, match="feature"):
+        list(parser)
+
+
+def test_strtonum():
+    assert strtonum.str2float(b"1.5e3") == 1500.0
+    assert strtonum.str2int("42") == 42
+    assert strtonum.parse_pair(b"3:4.5") == (2, 3.0, 4.5)
+    assert strtonum.parse_pair(b"7") == (1, 7.0, None)
+    assert strtonum.parse_pair(b"") == (0, None, None)
+    assert strtonum.parse_triple(b"1:2:3.5") == (3, 1.0, 2.0, 3.5)
