@@ -1,0 +1,18 @@
+"""JAX bridge: RowBlocks -> mesh-placed jax.Array batches + URI checkpoints.
+
+This is the genuinely new, TPU-native layer (SURVEY.md §7 stage 4): the
+reference's ThreadedIter feeding a training binary becomes a double-buffered
+host loader emitting statically-shaped device arrays against an explicit
+mesh/sharding, with per-host input sharding riding the same InputSplit math.
+"""
+
+from dmlc_core_tpu.bridge.batching import (  # noqa: F401
+    DenseBatch,
+    SparseBatch,
+    dense_batches,
+    sparse_batches,
+    block_to_dense,
+    block_to_sparse,
+)
+from dmlc_core_tpu.bridge.loader import MeshBatchLoader  # noqa: F401
+from dmlc_core_tpu.bridge.checkpoint import save_checkpoint, load_checkpoint  # noqa: F401

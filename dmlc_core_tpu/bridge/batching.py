@@ -1,0 +1,151 @@
+"""Ragged CSR RowBlocks -> statically-shaped batches XLA can compile once.
+
+The hard part SURVEY.md §7 calls out: RowBlock is ragged, XLA wants static
+shapes.  Two TPU-friendly layouts:
+
+- :class:`DenseBatch` — densified ``[batch, num_feature]`` features; right for
+  low-dimensional dense data (csv/HIGGS) and MXU matmuls;
+- :class:`SparseBatch` — flat COO-ish ``(value[N], index[N], row_id[N])`` with
+  the nonzero count padded up to a *bucket* (power-of-two style) so the number
+  of distinct compiled shapes stays logarithmic; padding rows carry
+  ``row_id == batch_size`` and are dropped by ``segment_sum`` with
+  ``num_segments = batch_size + 1``.
+
+Both are pytrees, so they pass straight into jit'd steps.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple, Optional
+
+import numpy as np
+
+from dmlc_core_tpu.data.parser import Parser
+from dmlc_core_tpu.data.row_block import RowBlock, concat_blocks
+from dmlc_core_tpu.utils.logging import CHECK, CHECK_LE
+
+__all__ = [
+    "DenseBatch",
+    "SparseBatch",
+    "block_to_dense",
+    "block_to_sparse",
+    "dense_batches",
+    "sparse_batches",
+    "bucket_size",
+]
+
+
+class DenseBatch(NamedTuple):
+    x: np.ndarray        # [B, F] float32
+    label: np.ndarray    # [B] float32
+    weight: np.ndarray   # [B] float32 (1.0 where absent; 0.0 marks padding)
+
+
+class SparseBatch(NamedTuple):
+    value: np.ndarray    # [N] float32
+    index: np.ndarray    # [N] int32 feature ids (0 on padding)
+    row_id: np.ndarray   # [N] int32 in [0, B]; B marks padding
+    label: np.ndarray    # [B] float32
+    weight: np.ndarray   # [B] float32 (0.0 marks padding rows)
+    field: Optional[np.ndarray] = None  # [N] int32 (libfm)
+
+
+def bucket_size(n: int, minimum: int = 256) -> int:
+    """Round up to the bucket ladder: 1.5x-spaced powers-of-two-ish sizes so
+    recompiles are O(log nnz) (static-shape discipline)."""
+    b = minimum
+    while b < n:
+        b = b * 3 // 2 if (b & (b - 1)) == 0 else 1 << (b.bit_length())
+    return b
+
+
+def block_to_dense(block: RowBlock, num_feature: int,
+                   batch_size: Optional[int] = None) -> DenseBatch:
+    """Densify a RowBlock into [B, num_feature] (B padded to batch_size)."""
+    n = block.size
+    b = batch_size or n
+    CHECK_LE(n, b, "block larger than batch_size")
+    x = np.zeros((b, num_feature), dtype=np.float32)
+    nnz = block.num_nonzero
+    if nnz:
+        rows = np.repeat(np.arange(n), np.diff(block.offset - block.offset[0]))
+        idx = np.asarray(block.index, dtype=np.int64)
+        CHECK(int(idx.max()) < num_feature, "feature index exceeds num_feature")
+        vals = (block.value if block.value is not None
+                else np.ones(nnz, dtype=np.float32))
+        x[rows, idx] = vals
+    label = np.zeros(b, dtype=np.float32)
+    label[:n] = block.label
+    weight = np.zeros(b, dtype=np.float32)
+    weight[:n] = block.weight if block.weight is not None else 1.0
+    return DenseBatch(x, label, weight)
+
+
+def block_to_sparse(block: RowBlock, nnz_bucket: Optional[int] = None,
+                    batch_size: Optional[int] = None) -> SparseBatch:
+    """Flatten a RowBlock into padded flat-COO (segment-sum ready)."""
+    n = block.size
+    b = batch_size or n
+    CHECK_LE(n, b, "block larger than batch_size")
+    nnz = block.num_nonzero
+    cap = nnz_bucket or bucket_size(max(nnz, 1))
+    CHECK_LE(nnz, cap, "nnz exceeds bucket")
+    value = np.zeros(cap, dtype=np.float32)
+    value[:nnz] = (block.value if block.value is not None
+                   else np.ones(nnz, dtype=np.float32))
+    index = np.zeros(cap, dtype=np.int32)
+    index[:nnz] = block.index
+    row_id = np.full(cap, b, dtype=np.int32)
+    row_id[:nnz] = np.repeat(np.arange(n, dtype=np.int32),
+                             np.diff(block.offset - block.offset[0]))
+    label = np.zeros(b, dtype=np.float32)
+    label[:n] = block.label
+    weight = np.zeros(b, dtype=np.float32)
+    weight[:n] = block.weight if block.weight is not None else 1.0
+    field = None
+    if block.field is not None:
+        field = np.zeros(cap, dtype=np.int32)
+        field[:nnz] = block.field
+    return SparseBatch(value, index, row_id, label, weight, field)
+
+
+class _Rebatcher:
+    """Slice a stream of variable-size RowBlocks into fixed-size batches."""
+
+    def __init__(self, parser: Parser, batch_size: int, drop_remainder: bool):
+        self._parser = parser
+        self._batch = batch_size
+        self._drop = drop_remainder
+
+    def __iter__(self) -> Iterator[RowBlock]:
+        pending: list = []
+        pending_rows = 0
+        for block in self._parser:
+            pending.append(block)
+            pending_rows += block.size
+            while pending_rows >= self._batch:
+                merged = pending[0] if len(pending) == 1 else concat_blocks(pending)
+                out = merged.slice(0, self._batch)
+                rest = merged.slice(self._batch, merged.size)
+                yield out
+                pending = [rest] if rest.size else []
+                pending_rows = rest.size
+        if pending_rows and not self._drop:
+            merged = pending[0] if len(pending) == 1 else concat_blocks(pending)
+            yield merged
+
+
+def dense_batches(parser: Parser, batch_size: int, num_feature: int,
+                  drop_remainder: bool = False) -> Iterator[DenseBatch]:
+    """Fixed-size dense batches from a parser (remainder zero-padded)."""
+    for block in _Rebatcher(parser, batch_size, drop_remainder):
+        yield block_to_dense(block, num_feature, batch_size)
+
+
+def sparse_batches(parser: Parser, batch_size: int,
+                   nnz_bucket: Optional[int] = None,
+                   drop_remainder: bool = False) -> Iterator[SparseBatch]:
+    """Fixed-size flat-COO batches; nnz padded to a bucket ladder."""
+    for block in _Rebatcher(parser, batch_size, drop_remainder):
+        cap = nnz_bucket or bucket_size(block.num_nonzero or 1)
+        yield block_to_sparse(block, cap, batch_size)

@@ -1,0 +1,88 @@
+"""Checkpoint pytrees to any URI-dispatched stream.
+
+The reference's checkpoint mechanism is "Serializable::Save to any URI"
+(io.h:112-126 + remote write streams, SURVEY.md §5.4).  The TPU equivalent:
+flatten a jax/numpy pytree, write each leaf as a typed array onto a
+:func:`dmlc_core_tpu.io.create_stream` (local/S3/GCS/... decided by URI), with
+a JSON header describing the tree structure — so a checkpoint written on a
+pod restores anywhere the URI resolves.
+
+Format: magic "DMLCTPU1" | u64 header_len | header JSON | leaf blobs in order.
+Header: {"leaves": [{"path": str, "dtype": str, "shape": [...]}, ...]}.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+from dmlc_core_tpu.io.stream import create_stream, create_stream_for_read
+from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+_MAGIC = b"DMLCTPU1"
+
+
+def _flatten(tree: Any):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = [jax.tree_util.keystr(path) for path, _ in leaves]
+    values = [leaf for _, leaf in leaves]
+    return paths, values, treedef
+
+
+def save_checkpoint(uri: str, tree: Any) -> None:
+    """Write a pytree of arrays/scalars to ``uri``."""
+    import jax
+
+    paths, values, _ = _flatten(tree)
+    arrays = [np.asarray(v) for v in values]
+    header = json.dumps({
+        "leaves": [
+            {"path": p, "dtype": str(a.dtype), "shape": list(a.shape)}
+            for p, a in zip(paths, arrays)
+        ]
+    }).encode("utf-8")
+    with create_stream(uri, "w") as fo:
+        fo.write(_MAGIC)
+        fo.write_u64(len(header))
+        fo.write(header)
+        for a in arrays:
+            fo.write(np.ascontiguousarray(a).tobytes())
+
+
+def load_checkpoint(uri: str, template: Any = None) -> Any:
+    """Read a checkpoint back.
+
+    With ``template`` (a pytree of matching structure), returns the template's
+    structure filled with loaded leaves.  Without, returns a flat
+    ``{path: array}`` dict.
+    """
+    import jax
+
+    with (create_stream_for_read(uri) or create_stream(uri, "r")) as fi:
+        CHECK_EQ(fi.read_exact(8), _MAGIC, "not a dmlc_core_tpu checkpoint")
+        header = json.loads(fi.read_exact(fi.read_u64()).decode("utf-8"))
+        loaded = {}
+        for leaf in header["leaves"]:
+            dtype = np.dtype(leaf["dtype"])
+            shape = tuple(leaf["shape"])
+            nbytes = dtype.itemsize * int(np.prod(shape)) if shape else dtype.itemsize
+            data = fi.read_exact(int(nbytes))
+            loaded[leaf["path"]] = np.frombuffer(data, dtype=dtype).reshape(shape)
+    if template is None:
+        return loaded
+    paths, values, treedef = _flatten(template)
+    CHECK_EQ(len(paths), len(loaded), "checkpoint/template structure mismatch")
+    new_values = []
+    for p, v in zip(paths, values):
+        CHECK(p in loaded, f"checkpoint missing leaf {p!r}")
+        arr = loaded[p]
+        CHECK_EQ(tuple(arr.shape), tuple(np.shape(v)),
+                 f"shape mismatch for leaf {p!r}")
+        new_values.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, new_values)

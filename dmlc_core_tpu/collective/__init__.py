@@ -1,0 +1,33 @@
+"""Rabit-shaped collectives implemented as XLA collectives over ICI/DCN.
+
+The reference provides *no* data-plane collectives in-repo — its tracker
+brokers TCP links for the external Rabit allreduce library (SURVEY.md §5.8).
+Here the data plane is ``jax.lax`` collectives compiled by XLA:
+
+- :mod:`dmlc_core_tpu.collective.api` — the process-level, Rabit-shaped API
+  (init/finalize/get_rank/get_world_size/allreduce/broadcast/tracker_print)
+  that downstream launchers and scripts use;
+- :mod:`dmlc_core_tpu.collective.mesh_collectives` — in-program, jit-compiled
+  collectives over a named mesh axis (allreduce/allgather/reducescatter/
+  broadcast/ppermute ring), for use inside shard_map'd training steps.
+"""
+
+from dmlc_core_tpu.collective.api import (  # noqa: F401
+    init,
+    finalize,
+    is_initialized,
+    get_rank,
+    get_world_size,
+    get_processor_name,
+    allreduce,
+    broadcast,
+    allgather,
+    tracker_print,
+    version_number,
+    checkpoint,
+    load_checkpoint,
+)
+from dmlc_core_tpu.collective.mesh_collectives import (  # noqa: F401
+    MeshCollective,
+    ring_allreduce,
+)

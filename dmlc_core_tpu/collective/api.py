@@ -1,0 +1,239 @@
+"""Process-level Rabit-shaped collective API.
+
+Mirrors the client contract the reference tracker serves (rabit's
+init/finalize/get_rank/get_world_size/allreduce/broadcast/version_number/
+checkpoint — the env-var protocol in SURVEY.md §5.6): each *process* is a
+rank; arrays are host numpy arrays; reduction happens across processes.
+
+Implementation: ``jax.distributed`` global runtime + one global 1-D mesh over
+every device of every process.  An allreduce builds a global array whose
+process-local shard is this rank's contribution, then runs a jit-compiled
+cross-device reduction (XLA lowers it to ICI/DCN collectives); the result is
+fetched fully-replicated.  Single-process runs degrade to local identity, so
+the same script works from a laptop to a pod (the reference's local-vs-cluster
+symmetry).
+
+Env contract (set by dmlc_core_tpu.tracker launchers, reference tracker.py):
+``DMLC_TASK_ID`` → process id, ``DMLC_NUM_WORKER`` → world size,
+``DMLC_COORDINATOR_URI``/``DMLC_COORDINATOR_PORT`` → jax.distributed
+coordinator address.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import socket
+import sys
+from typing import Any, Optional
+
+import numpy as np
+
+from dmlc_core_tpu.param import get_env
+from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ, log_info
+
+__all__ = [
+    "init",
+    "finalize",
+    "is_initialized",
+    "get_rank",
+    "get_world_size",
+    "get_processor_name",
+    "allreduce",
+    "broadcast",
+    "allgather",
+    "tracker_print",
+    "version_number",
+    "checkpoint",
+    "load_checkpoint",
+]
+
+_state: dict = {
+    "initialized": False,
+    "distributed": False,
+    "mesh": None,
+    "version": 0,
+}
+
+_OPS = {"sum": np.add, "max": np.maximum, "min": np.minimum, "prod": np.multiply}
+
+
+def init(args: Optional[dict] = None) -> None:
+    """Initialize the collective runtime (rabit::Init equivalent).
+
+    In a tracker-launched job (DMLC_NUM_WORKER > 1 in the environment) this
+    calls ``jax.distributed.initialize`` against the coordinator the launcher
+    advertised; standalone it is a no-op beyond building the local mesh.
+    """
+    if _state["initialized"]:
+        return
+    import jax
+
+    env = dict(os.environ)
+    if args:
+        env.update({k: str(v) for k, v in args.items()})
+    num_worker = int(env.get("DMLC_NUM_WORKER", "1"))
+    task_id = int(env.get("DMLC_TASK_ID", "0"))
+    coord_uri = env.get("DMLC_COORDINATOR_URI", "")
+    coord_port = env.get("DMLC_COORDINATOR_PORT", "")
+    if num_worker > 1 and coord_uri:
+        jax.distributed.initialize(
+            coordinator_address=f"{coord_uri}:{coord_port}",
+            num_processes=num_worker,
+            process_id=task_id,
+        )
+        _state["distributed"] = True
+    from dmlc_core_tpu.parallel.mesh import make_mesh
+
+    _state["mesh"] = make_mesh({"world": len(jax.devices())})
+    _state["initialized"] = True
+    atexit.register(finalize)
+
+
+def finalize() -> None:
+    """rabit::Finalize equivalent."""
+    if not _state["initialized"]:
+        return
+    if _state["distributed"]:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    _state.update(initialized=False, distributed=False, mesh=None)
+
+
+def is_initialized() -> bool:
+    return _state["initialized"]
+
+
+def _require_init() -> None:
+    CHECK(_state["initialized"], "collective.init() must be called first")
+
+
+def get_rank() -> int:
+    _require_init()
+    import jax
+
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    _require_init()
+    import jax
+
+    return jax.process_count()
+
+
+def get_processor_name() -> str:
+    return socket.gethostname()
+
+
+def _global_op(value: np.ndarray, op: str, root: Optional[int] = None,
+               gather: bool = False) -> np.ndarray:
+    """Shared engine: stack per-process contributions on a leading axis,
+    reduce (or gather) on device, return replicated result."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    _require_init()
+    value = np.asarray(value)
+    nproc = jax.process_count()
+    if nproc == 1:
+        if gather:
+            return value[None]
+        if root is not None:
+            return value
+        return value
+    mesh = _state["mesh"]
+    ndev = mesh.devices.size
+    per_proc = ndev // nproc
+    # leading axis = device slots; each process replicates its value into its
+    # local slots so the global array's shard on process p holds value_p.
+    local = np.broadcast_to(value[None], (per_proc,) + value.shape)
+    sharding = NamedSharding(mesh, P("world"))
+    garr = jax.make_array_from_process_local_data(sharding, local,
+                                                  (ndev,) + value.shape)
+    out_sharding = NamedSharding(mesh, P())
+    if gather:
+        # take one slot per process: slots are process-major
+        fn = jax.jit(lambda x: x[::per_proc],
+                     out_shardings=NamedSharding(mesh, P()))
+        return np.asarray(fn(garr))
+    if root is not None:
+        fn = jax.jit(lambda x: x[root * per_proc],
+                     out_shardings=out_sharding)
+        return np.asarray(fn(garr))
+    reducers = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min, "prod": jnp.prod}
+    CHECK(op in reducers, f"unknown reduce op {op!r}")
+    red = reducers[op]
+    # each process's value appears per_proc times; correct for duplication
+    if op == "sum":
+        fn = jax.jit(lambda x: red(x[::per_proc], axis=0), out_shardings=out_sharding)
+    elif op == "prod":
+        fn = jax.jit(lambda x: red(x[::per_proc], axis=0), out_shardings=out_sharding)
+    else:
+        fn = jax.jit(lambda x: red(x, axis=0), out_shardings=out_sharding)
+    return np.asarray(fn(garr))
+
+
+def allreduce(value: Any, op: str = "sum") -> np.ndarray:
+    """Elementwise reduce across all ranks; result identical on every rank
+    (rabit::Allreduce).  ``op`` in {sum, max, min, prod}."""
+    return _global_op(np.asarray(value), op)
+
+
+def broadcast(value: Any, root: int = 0) -> np.ndarray:
+    """Broadcast ``value`` from ``root`` to all ranks (rabit::Broadcast).
+    Every rank must pass an array of the same shape/dtype."""
+    return _global_op(np.asarray(value), "sum", root=root)
+
+
+def allgather(value: Any) -> np.ndarray:
+    """Gather each rank's array; returns [world, ...] on every rank."""
+    return _global_op(np.asarray(value), "sum", gather=True)
+
+
+def tracker_print(msg: str) -> None:
+    """Print through the tracker on rank 0 (rabit::TrackerPrint)."""
+    _require_init()
+    if get_rank() == 0:
+        sys.stderr.write(str(msg).rstrip("\n") + "\n")
+        sys.stderr.flush()
+
+
+def version_number() -> int:
+    """Checkpoint version counter (rabit::VersionNumber)."""
+    return _state["version"]
+
+
+def checkpoint(model: Any, uri_template: str = "") -> None:
+    """Persist a model pytree for failure recovery (rabit::Checkpoint).
+
+    Slice-granular resume (SURVEY.md §5.3): every rank writes rank-0-identical
+    state via the URI-dispatched store; restart resumes from the latest version.
+    """
+    _state["version"] += 1
+    if uri_template and get_rank() == 0:
+        from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
+
+        save_checkpoint(uri_template.format(version=_state["version"]), model)
+
+
+def load_checkpoint(uri_template: str = "", version: Optional[int] = None) -> Any:
+    """Load the checkpoint saved by :func:`checkpoint`; None when absent."""
+    if not uri_template:
+        return None
+    from dmlc_core_tpu.bridge.checkpoint import load_checkpoint as _load
+
+    ver = version if version is not None else _state["version"]
+    if ver <= 0:
+        return None
+    try:
+        model = _load(uri_template.format(version=ver))
+    except (OSError, IOError):
+        return None
+    _state["version"] = ver
+    return model

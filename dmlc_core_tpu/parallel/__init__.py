@@ -1,0 +1,15 @@
+"""Device-mesh construction and sharding helpers.
+
+This layer replaces the reference tracker's tree/ring topology machinery
+(tracker/dmlc_tracker/tracker.py:165-252): on TPU the torus topology is
+hardware (ICI), so "topology awareness" surfaces as `jax.sharding.Mesh`
+construction + NamedShardings, and the collectives ride ICI/DCN via XLA.
+"""
+
+from dmlc_core_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    make_hybrid_mesh,
+    data_sharding,
+    replicated_sharding,
+    local_shard_info,
+)

@@ -1,0 +1,14 @@
+"""Small version-compat shims for jax API moves."""
+
+from __future__ import annotations
+
+
+def get_shard_map():
+    """jax.shard_map (new) or jax.experimental.shard_map.shard_map (old)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+    return shard_map

@@ -7,8 +7,17 @@ prescribes (the "fake cluster" the reference never had).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment points at a real accelerator: the test
+# suite validates sharding semantics on a virtual mesh, not device perf.
+# Note: the image's sitecustomize registers the axon TPU plugin and pins
+# jax_platforms via config, so the env var alone is not enough — override the
+# config after import too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
