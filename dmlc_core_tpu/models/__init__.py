@@ -1,0 +1,14 @@
+"""Model families on top of the data/bridge/collective stack.
+
+The reference is the substrate *under* XGBoost/MXNet; the TPU-native rebuild
+ships the two downstream workloads its north star names (BASELINE.json):
+
+- :mod:`dmlc_core_tpu.models.linear` — (sparse/dense) linear learners with
+  logistic/squared objectives, psum'd data-parallel SGD;
+- :mod:`dmlc_core_tpu.models.gbdt`  — histogram-based gradient-boosted trees
+  (the XGBoost hist algorithm), fully jit-compiled: binning, per-level
+  scatter-add histograms, best-split search, and ensemble prediction.
+"""
+
+from dmlc_core_tpu.models.linear import LinearModel, LinearParam  # noqa: F401
+from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam, TreeEnsemble  # noqa: F401

@@ -1,0 +1,15 @@
+"""TPU compute ops: quantile binning, gradient histograms, sparse segment ops.
+
+These are the device-side kernels the DMLC ecosystem runs on top of this
+library (XGBoost's hist algorithm, linear learners).  The reference contains
+no device code — SURVEY.md §6's north star is "XGBoost hist on TPU", and these
+ops are its core: binning + scatter-add gradient histograms + segment
+reductions, all static-shape and jit-compiled.
+"""
+
+from dmlc_core_tpu.ops.histogram import (  # noqa: F401
+    quantile_boundaries,
+    apply_bins,
+    grad_histogram,
+)
+from dmlc_core_tpu.ops.sparse import segment_matvec, sparse_logit  # noqa: F401

@@ -1,0 +1,102 @@
+"""Quantile binning + gradient histograms (the XGBoost-hist core on TPU).
+
+Design notes (TPU-first):
+- binning is a one-time ``searchsorted`` per feature (vmapped, compiled once);
+  bins are uint8/int32 — HBM-friendly, 4x smaller than raw floats at 256 bins;
+- the per-round gradient histogram is one flat ``segment_sum`` (XLA scatter-
+  add) over ``node*F*nbins + f*nbins + bin`` ids — a single fused kernel, no
+  per-feature loops;
+- everything is static-shape: ``num_bins``, ``num_features``, and the level's
+  node count are compile-time constants, so XLA tiles the scatter efficiently
+  and the whole boosting round stays inside one jit.
+
+Under a sharded batch (rows split over the "data" mesh axis) GSPMD turns the
+segment_sum into per-shard partial histograms + an all-reduce over ICI —
+exactly the distributed-hist aggregation XGBoost does over Rabit
+(SURVEY.md §2.9), but compiler-scheduled.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["quantile_boundaries", "apply_bins", "grad_histogram"]
+
+
+def quantile_boundaries(sample: np.ndarray, num_bins: int) -> np.ndarray:
+    """Per-feature quantile split points from a host-side sample.
+
+    Returns boundaries [F, num_bins-1]; feature value v lands in bin
+    ``searchsorted(boundaries[f], v)`` in [0, num_bins).  (The reference
+    ecosystem's quantile sketch; a host numpy quantile is exact for the
+    sampled rows and runs once per training job.)
+    """
+    sample = np.asarray(sample, dtype=np.float32)
+    qs = np.linspace(0, 1, num_bins + 1)[1:-1]
+    bounds = np.quantile(sample, qs, axis=0).T.astype(np.float32)  # [F, nb-1]
+    # strictly increasing boundaries keep searchsorted stable on ties
+    eps = np.float32(1e-6)
+    bounds = np.maximum.accumulate(bounds +
+                                   eps * np.arange(bounds.shape[1],
+                                                   dtype=np.float32), axis=1)
+    return bounds
+
+
+def apply_bins(x, boundaries):
+    """Bin dense features: x [B, F] float -> bins [B, F] int32 in [0, num_bins).
+
+    jit-safe; vmapped searchsorted over the feature axis.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    boundaries = jnp.asarray(boundaries)
+
+    def one_feature(col, bounds):
+        return jnp.searchsorted(bounds, col, side="right").astype(jnp.int32)
+
+    return jax.vmap(one_feature, in_axes=(1, 0), out_axes=1)(x, boundaries)
+
+
+def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,
+                   model_axis: Optional[str] = None):
+    """Per-(node, feature, bin) gradient/hessian sums.
+
+    Args:
+      bins:     [B, F] int32 binned features.
+      node_ids: [B] int32 current tree-node of each row (in [0, num_nodes)).
+      grad/hess: [B] float32 (pre-multiplied by instance weight; padding rows
+        must carry 0 weight so they vanish from every bin).
+      num_nodes, num_bins: static.
+      model_axis: optional mesh axis name — when set, the histogram output is
+        sharding-constrained to split the feature dim over that axis
+        (tensor-parallel hist for very wide feature spaces).
+
+    Returns (G, H): each [num_nodes, F, num_bins] float32.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    bins = jnp.asarray(bins)
+    B, F = bins.shape
+    ids = (node_ids[:, None] * (F * num_bins)
+           + jnp.arange(F, dtype=jnp.int32)[None, :] * num_bins
+           + bins)                                    # [B, F]
+    flat_ids = ids.reshape(-1)
+    nseg = num_nodes * F * num_bins
+    g_flat = jnp.broadcast_to(grad[:, None], (B, F)).reshape(-1)
+    h_flat = jnp.broadcast_to(hess[:, None], (B, F)).reshape(-1)
+    G = jax.ops.segment_sum(g_flat, flat_ids, num_segments=nseg)
+    H = jax.ops.segment_sum(h_flat, flat_ids, num_segments=nseg)
+    G = G.reshape(num_nodes, F, num_bins)
+    H = H.reshape(num_nodes, F, num_bins)
+    if model_axis is not None:
+        from jax.sharding import PartitionSpec as P
+
+        constraint = P(None, model_axis, None)
+        G = jax.lax.with_sharding_constraint(G, constraint)
+        H = jax.lax.with_sharding_constraint(H, constraint)
+    return G, H

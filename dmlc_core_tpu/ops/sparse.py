@@ -1,0 +1,43 @@
+"""Sparse segment ops for flat-COO batches (the RowBlock SDot analog on TPU).
+
+The reference's ``Row::SDot`` (data.h:133-148) is a scalar loop; on TPU the
+batch-level equivalent is gather + ``segment_sum`` over the flat nonzero
+stream of a :class:`dmlc_core_tpu.bridge.batching.SparseBatch` — one fused
+XLA kernel per batch, static shapes via the nnz bucket ladder.
+"""
+
+from __future__ import annotations
+
+__all__ = ["segment_matvec", "sparse_logit", "segment_transpose_matvec"]
+
+
+def segment_matvec(w, value, index, row_id, batch_size: int):
+    """Per-row sparse dot: out[b] = sum_{nnz in row b} w[index] * value.
+
+    Padding entries carry ``row_id == batch_size`` and land in the dropped
+    extra segment.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    contrib = w[index] * value
+    seg = jax.ops.segment_sum(contrib, row_id, num_segments=batch_size + 1)
+    return seg[:batch_size]
+
+
+def segment_transpose_matvec(r, value, index, row_id, num_feature: int):
+    """Transpose product: out[f] = sum_{nnz with index==f} r[row] * value.
+
+    ``r`` must have a trailing 0 sentinel slot (r[batch_size] == 0) so padding
+    rows contribute nothing; pass ``jnp.append(r, 0.0)`` or a [B+1] array.
+    """
+    import jax
+
+    contrib = r[row_id] * value
+    return jax.ops.segment_sum(contrib, index, num_segments=num_feature)
+
+
+def sparse_logit(w, b, batch, num_feature: int):
+    """Margin for a SparseBatch under a linear model: Xw + b."""
+    bsz = batch.label.shape[0]
+    return segment_matvec(w, batch.value, batch.index, batch.row_id, bsz) + b
