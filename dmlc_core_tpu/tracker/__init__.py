@@ -1,0 +1,21 @@
+"""Distributed job layer: launch + rendezvous (the reference's L7).
+
+Capability parity with tracker/dmlc_tracker/ (reference):
+
+- :mod:`rendezvous` — the Rabit tracker: TCP rank rendezvous
+  (wire-compatible with Rabit clients: magic 0xff99, framed int/str protocol),
+  tree+ring topology service, jobid-based rank recovery, PS bootstrap;
+- :mod:`submit`/:mod:`opts` — the ``dmlc-submit`` CLI and option schema;
+- backends: :mod:`local` (process-per-worker with retry), :mod:`ssh`,
+  :mod:`mpi`, :mod:`sge`, and the new :mod:`tpu_vm` backend that launches one
+  process per TPU-VM host and wires ``jax.distributed`` coordination;
+- :mod:`launcher` — container-side bootstrap.
+
+TPU-native recast (SURVEY.md §5.8): the tracker keeps its launch/retry/
+observability duties, adds a ``jax.distributed`` coordinator to the env
+contract (``DMLC_COORDINATOR_URI/PORT``), and the data plane the topology
+used to serve moves into XLA collectives over ICI/DCN.
+"""
+
+from dmlc_core_tpu.tracker.rendezvous import RabitTracker, PSTracker  # noqa: F401
+from dmlc_core_tpu.tracker.submit import submit_job  # noqa: F401

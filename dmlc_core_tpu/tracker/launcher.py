@@ -1,0 +1,58 @@
+"""Container-side bootstrap (reference tracker/dmlc_tracker/launcher.py).
+
+Prepares the environment inside a freshly-scheduled container and execs the
+worker command: unpacks job archives (``DMLC_JOB_ARCHIVES``), assembles
+``LD_LIBRARY_PATH``/``PYTHONPATH``, infers the role on SGE, then replaces
+itself with the command.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import zipfile
+
+__all__ = ["main"]
+
+
+def unpack_archives(spec: str) -> None:
+    """Unzip '#'-renamable archives listed in DMLC_JOB_ARCHIVES."""
+    for item in spec.split(":"):
+        if not item:
+            continue
+        src, _, dest = item.partition("#")
+        dest = dest or os.path.splitext(os.path.basename(src))[0]
+        if os.path.exists(src) and not os.path.exists(dest):
+            with zipfile.ZipFile(src) as zf:
+                zf.extractall(dest)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m dmlc_core_tpu.tracker.launcher CMD [ARGS...]",
+              file=sys.stderr)
+        return 2
+    env = os.environ
+    unpack_archives(env.get("DMLC_JOB_ARCHIVES", ""))
+    # library paths
+    extra_lib = [p for p in (env.get("DMLC_HDFS_OPTS", ""),) if p]
+    ld = env.get("LD_LIBRARY_PATH", "")
+    for p in (os.path.join(sys.prefix, "lib"),):
+        if p not in ld:
+            ld = f"{ld}:{p}" if ld else p
+    env["LD_LIBRARY_PATH"] = ld
+    if extra_lib:
+        env["LIBHDFS_OPTS"] = " ".join(extra_lib)
+    # role inference on SGE array jobs (reference launcher.py)
+    if "SGE_TASK_ID" in env and "DMLC_TASK_ID" not in env:
+        env["DMLC_TASK_ID"] = str(int(env["SGE_TASK_ID"]) - 1)
+    cwd = env.get("DMLC_JOB_CWD")
+    if cwd:
+        os.chdir(cwd)
+    return subprocess.call(argv, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Local backend: one subprocess per worker/server on this host.
+
+Reference: tracker/dmlc_tracker/local.py:12-72 — thread-per-process launch,
+``DMLC_TASK_ID``/``DMLC_ROLE`` env, retry via ``DMLC_NUM_ATTEMPT``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, List
+
+from dmlc_core_tpu.tracker.submit import submit_job
+
+__all__ = ["submit", "exec_cmd"]
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
+             num_attempt: int = 1) -> None:
+    """Run one task with retry (reference local.py:25-40)."""
+    env = os.environ.copy()
+    env.update(pass_env)
+    env["DMLC_TASK_ID"] = str(taskid)
+    env["DMLC_ROLE"] = role
+    env["DMLC_NUM_ATTEMPT"] = str(num_attempt)
+    num_retry = num_attempt
+    while True:
+        ret = subprocess.call(cmd, env=env)
+        if ret == 0:
+            logger.debug("task %s:%d finished", role, taskid)
+            return
+        num_retry -= 1
+        if num_retry <= 0:
+            raise RuntimeError(f"task {role}:{taskid} failed with exit {ret}")
+        logger.warning("task %s:%d failed (exit %d); retrying", role, taskid, ret)
+        env["DMLC_NUM_ATTEMPT"] = str(num_retry)
+
+
+def submit(opts) -> None:
+    def fun_submit(envs: Dict[str, str]) -> None:
+        threads = []
+        errors: List[BaseException] = []
+
+        def run(role: str, taskid: int) -> None:
+            try:
+                exec_cmd(opts.command, role, taskid, envs,
+                         num_attempt=getattr(opts, "num_attempt", 1))
+            except BaseException as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        for i in range(opts.num_servers):
+            t = threading.Thread(target=run, args=("server", i), daemon=True)
+            t.start()
+            threads.append(t)
+        for i in range(opts.num_workers):
+            t = threading.Thread(target=run, args=("worker", i), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+    submit_job(opts, fun_submit, wait=False)

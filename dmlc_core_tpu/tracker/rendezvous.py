@@ -1,0 +1,355 @@
+"""Rank rendezvous + topology service, wire-compatible with Rabit clients.
+
+Reimplements the reference tracker protocol (tracker/dmlc_tracker/tracker.py):
+
+- framed socket protocol: native-endian int32s and length-prefixed strings
+  (ExSocket, tracker.py:24-47), handshake magic 0xff99 (tracker.py:50);
+- commands: ``start`` / ``recover`` / ``print`` / ``shutdown``
+  (tracker.py:269-291);
+- batch rank assignment sorted by host (tracker.py:295-311) with
+  jobid -> rank recovery (decide_rank, tracker.py:73-78);
+- topology: binary tree + parent map (tracker.py:185-191) and the
+  tree-sharing data-recovery ring (tracker.py:193-225), relabeled so ring
+  order is rank order (get_link_map, tracker.py:227-252);
+- the connection-brokering loop that repeats until every rank reports all its
+  links connected (assign_rank, tracker.py:80-135).
+
+On TPU the data plane no longer consumes these links (XLA collectives do the
+reduction), but the tracker stays wire-compatible so existing Rabit clients
+(XGBoost binaries) can rendezvous against it unchanged; our own workers use
+only the env contract + ``jax.distributed`` coordination.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+MAGIC = 0xFF99
+
+
+class FramedSocket:
+    """int32/length-prefixed-string framing (reference ExSocket)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def recvall(self, nbytes: int) -> bytes:
+        chunks = []
+        nread = 0
+        while nread < nbytes:
+            chunk = self.sock.recv(min(nbytes - nread, 1024))
+            if not chunk:
+                raise ConnectionError("peer closed during recvall")
+            nread += len(chunk)
+            chunks.append(chunk)
+        return b"".join(chunks)
+
+    def recvint(self) -> int:
+        return struct.unpack("@i", self.recvall(4))[0]
+
+    def sendint(self, n: int) -> None:
+        self.sock.sendall(struct.pack("@i", n))
+
+    def sendstr(self, s: str) -> None:
+        self.sendint(len(s))
+        self.sock.sendall(s.encode())
+
+    def recvstr(self) -> str:
+        return self.recvall(self.recvint()).decode()
+
+
+def _resolve_ip(host: str) -> str:
+    return socket.getaddrinfo(host, None)[0][4][0]
+
+
+class WorkerEntry:
+    """One connected worker (reference SlaveEntry)."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = FramedSocket(sock)
+        self.host = _resolve_ip(addr[0])
+        magic = self.sock.recvint()
+        if magic != MAGIC:
+            raise ConnectionError(f"invalid magic {magic:#x} from {self.host}")
+        self.sock.sendint(MAGIC)
+        self.rank = self.sock.recvint()
+        self.world_size = self.sock.recvint()
+        self.jobid = self.sock.recvstr()
+        self.cmd = self.sock.recvstr()
+        self.wait_accept = 0
+        self.port: Optional[int] = None
+
+    def decide_rank(self, job_map: Dict[str, int]) -> int:
+        if self.rank >= 0:
+            return self.rank
+        if self.jobid != "NULL" and self.jobid in job_map:
+            return job_map[self.jobid]
+        return -1
+
+    def assign_rank(self, rank: int, wait_conn: Dict[int, "WorkerEntry"],
+                    tree_map, parent_map, ring_map) -> List[int]:
+        self.rank = rank
+        nnset = set(tree_map[rank])
+        rprev, rnext = ring_map[rank]
+        self.sock.sendint(rank)
+        self.sock.sendint(parent_map[rank])
+        self.sock.sendint(len(tree_map))
+        self.sock.sendint(len(nnset))
+        for r in nnset:
+            self.sock.sendint(r)
+        if rprev not in (-1, rank):
+            nnset.add(rprev)
+            self.sock.sendint(rprev)
+        else:
+            self.sock.sendint(-1)
+        if rnext not in (-1, rank):
+            nnset.add(rnext)
+            self.sock.sendint(rnext)
+        else:
+            self.sock.sendint(-1)
+        # broker connections until this worker has all links
+        while True:
+            ngood = self.sock.recvint()
+            goodset = {self.sock.recvint() for _ in range(ngood)}
+            assert goodset.issubset(nnset), (goodset, nnset)
+            badset = nnset - goodset
+            conset = [r for r in badset if r in wait_conn]
+            self.sock.sendint(len(conset))
+            self.sock.sendint(len(badset) - len(conset))
+            for r in conset:
+                self.sock.sendstr(wait_conn[r].host)
+                self.sock.sendint(wait_conn[r].port)
+                self.sock.sendint(r)
+            nerr = self.sock.recvint()
+            if nerr != 0:
+                continue
+            self.port = self.sock.recvint()
+            done = []
+            for r in conset:
+                wait_conn[r].wait_accept -= 1
+                if wait_conn[r].wait_accept == 0:
+                    done.append(r)
+            for r in done:
+                wait_conn.pop(r, None)
+            self.wait_accept = len(badset) - len(conset)
+            return done
+
+
+def bind_free_port(host: str, port: int = 9091,
+                   port_end: int = 9999) -> Tuple[socket.socket, int]:
+    """Bind the first free port in [port, port_end) (reference tracker.py:141-152)."""
+    family = socket.getaddrinfo(host, None)[0][0]
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    for p in range(port, port_end):
+        try:
+            sock.bind((host, p))
+            return sock, p
+        except socket.error as err:
+            if err.errno in (98, 48):  # EADDRINUSE linux/mac
+                continue
+            raise
+    raise OSError(f"no free port in [{port}, {port_end})")
+
+
+class RabitTracker:
+    """The rendezvous server (reference RabitTracker, tracker.py:137-334)."""
+
+    def __init__(self, host_ip: str, num_workers: int, port: int = 9091,
+                 port_end: int = 9999):
+        self.sock, self.port = bind_free_port(host_ip, port, port_end)
+        self.sock.listen(256)
+        self.host_ip = host_ip
+        self.num_workers = num_workers
+        self.thread: Optional[threading.Thread] = None
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        logger.info("start listening on %s:%d", host_ip, self.port)
+
+    # -- topology (tracker.py:165-252) ---------------------------------------
+    @staticmethod
+    def _tree_neighbors(rank: int, n: int) -> List[int]:
+        rank = rank + 1
+        out = []
+        if rank > 1:
+            out.append(rank // 2 - 1)
+        if rank * 2 - 1 < n:
+            out.append(rank * 2 - 1)
+        if rank * 2 < n:
+            out.append(rank * 2)
+        return out
+
+    @classmethod
+    def get_tree(cls, n: int):
+        tree_map = {r: cls._tree_neighbors(r, n) for r in range(n)}
+        parent_map = {r: (r + 1) // 2 - 1 for r in range(n)}
+        return tree_map, parent_map
+
+    @classmethod
+    def _share_ring_order(cls, tree_map, parent_map, r: int) -> List[int]:
+        """DFS order that keeps ring hops close to tree links (used to recover
+        local data, reference tracker.py:193-214)."""
+        children = set(tree_map[r]) - {parent_map[r]}
+        if not children:
+            return [r]
+        out = [r]
+        for i, v in enumerate(sorted(children)):
+            sub = cls._share_ring_order(tree_map, parent_map, v)
+            if i == len(children) - 1:
+                sub.reverse()
+            out += sub
+        return out
+
+    @classmethod
+    def get_ring(cls, tree_map, parent_map):
+        order = cls._share_ring_order(tree_map, parent_map, 0)
+        assert len(order) == len(tree_map)
+        n = len(tree_map)
+        ring_map = {}
+        for i in range(n):
+            ring_map[order[i]] = (order[(i - 1) % n], order[(i + 1) % n])
+        return ring_map
+
+    @classmethod
+    def get_link_map(cls, n: int):
+        """Relabel ranks so ring order == rank order (tracker.py:227-252)."""
+        tree_map, parent_map = cls.get_tree(n)
+        ring_map = cls.get_ring(tree_map, parent_map)
+        rmap = {0: 0}
+        k = 0
+        for i in range(n - 1):
+            k = ring_map[k][1]
+            rmap[k] = i + 1
+        ring_out = {rmap[k]: (rmap[v[0]], rmap[v[1]]) for k, v in ring_map.items()}
+        tree_out = {rmap[k]: [rmap[x] for x in v] for k, v in tree_map.items()}
+        parent_out = {rmap[k]: (rmap[v] if k != 0 else -1)
+                      for k, v in parent_map.items()}
+        return tree_out, parent_out, ring_out
+
+    # -- env contract ---------------------------------------------------------
+    def worker_envs(self) -> Dict[str, str]:
+        return {"DMLC_TRACKER_URI": self.host_ip,
+                "DMLC_TRACKER_PORT": str(self.port)}
+
+    # -- accept loop (tracker.py:254-320) -------------------------------------
+    def _accept_workers(self, n: int) -> None:
+        shutdown: Dict[int, WorkerEntry] = {}
+        wait_conn: Dict[int, WorkerEntry] = {}
+        job_map: Dict[str, int] = {}
+        pending: List[WorkerEntry] = []
+        tree_map = None
+        todo_nodes: List[int] = []
+        while len(shutdown) != n:
+            fd, addr = self.sock.accept()
+            try:
+                s = WorkerEntry(fd, addr)
+            except ConnectionError as err:
+                logger.warning("rejected connection: %s", err)
+                fd.close()
+                continue
+            if s.cmd == "print":
+                logger.info(s.sock.recvstr().strip())
+                continue
+            if s.cmd == "shutdown":
+                assert s.rank >= 0 and s.rank not in shutdown
+                shutdown[s.rank] = s
+                logger.debug("shutdown signal from %d", s.rank)
+                continue
+            assert s.cmd in ("start", "recover"), s.cmd
+            if tree_map is None:
+                assert s.cmd == "start"
+                if s.world_size > 0:
+                    n = s.world_size
+                tree_map, parent_map, ring_map = self.get_link_map(n)
+                todo_nodes = list(range(n))
+            else:
+                assert s.world_size in (-1, n)
+            if s.cmd == "recover":
+                assert s.rank >= 0
+            rank = s.decide_rank(job_map)
+            if rank == -1:
+                assert todo_nodes
+                pending.append(s)
+                if len(pending) == len(todo_nodes):
+                    pending.sort(key=lambda x: x.host)
+                    for p in pending:
+                        rank = todo_nodes.pop(0)
+                        if p.jobid != "NULL":
+                            job_map[p.jobid] = rank
+                        p.assign_rank(rank, wait_conn, tree_map, parent_map,
+                                      ring_map)
+                        if p.wait_accept > 0:
+                            wait_conn[rank] = p
+                        logger.debug("%s from %s; assigned rank %d",
+                                     p.cmd, p.host, p.rank)
+                    pending = []
+                if not todo_nodes:
+                    logger.info("@tracker all of %d nodes started", n)
+                    self.start_time = time.time()
+            else:
+                s.assign_rank(rank, wait_conn, tree_map, parent_map, ring_map)
+                logger.debug("%s signal from %d", s.cmd, s.rank)
+                if s.wait_accept > 0:
+                    wait_conn[rank] = s
+        self.end_time = time.time()
+        logger.info("@tracker all nodes finished; %.3f secs between start and finish",
+                    (self.end_time - (self.start_time or self.end_time)))
+
+    def start(self, num_workers: Optional[int] = None) -> None:
+        n = num_workers if num_workers is not None else self.num_workers
+        self.thread = threading.Thread(target=self._accept_workers, args=(n,),
+                                       daemon=True)
+        self.thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.time() + timeout
+        while self.thread.is_alive():
+            self.thread.join(0.1)
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError("tracker did not finish in time")
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+
+class PSTracker:
+    """Parameter-server scheduler bootstrap (reference PSTracker,
+    tracker.py:336-386): starts the ps-lite scheduler process locally and
+    exports the DMLC_PS_ROOT env contract."""
+
+    def __init__(self, host_ip: str, cmd: Optional[str], port: int = 9091,
+                 port_end: int = 9999, envs: Optional[dict] = None):
+        self.host_ip = host_ip
+        self.cmd = cmd
+        if cmd:
+            sock, self.port = bind_free_port(host_ip, port, port_end)
+            sock.close()  # scheduler process rebinds it
+            env = dict(__import__("os").environ)
+            env.update({k: str(v) for k, v in (envs or {}).items()})
+            env["DMLC_ROLE"] = "scheduler"
+            env["DMLC_PS_ROOT_URI"] = str(host_ip)
+            env["DMLC_PS_ROOT_PORT"] = str(self.port)
+            self.thread = threading.Thread(
+                target=lambda: subprocess.check_call(cmd, shell=True, env=env),
+                daemon=True)
+            self.thread.start()
+        else:
+            self.port = None
+            self.thread = None
+
+    def worker_envs(self) -> Dict[str, str]:
+        if self.cmd:
+            return {"DMLC_PS_ROOT_URI": self.host_ip,
+                    "DMLC_PS_ROOT_PORT": str(self.port)}
+        return {}
+
+    def join(self) -> None:
+        if self.thread is not None:
+            self.thread.join()

@@ -1,0 +1,43 @@
+"""Sun Grid Engine backend: qsub array job.
+
+Reference: tracker/dmlc_tracker/sge.py — generates a run script exporting
+``DMLC_TASK_ID=$SGE_TASK_ID`` and submits it as an array job.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import stat
+import subprocess
+from typing import Dict
+
+from dmlc_core_tpu.tracker.submit import submit_job
+
+__all__ = ["submit"]
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+def submit(opts) -> None:
+    def fun_submit(envs: Dict[str, str]) -> None:
+        runscript = os.path.join(os.getcwd(), f"{opts.jobname}.sge.sh")
+        with open(runscript, "w") as f:
+            f.write("#!/bin/bash\n#$ -S /bin/bash\n")
+            f.write(f"#$ -q {opts.queue}\n")
+            f.write("export DMLC_TASK_ID=$((SGE_TASK_ID - 1))\n")
+            for k, v in envs.items():
+                f.write(f"export {k}={v}\n")
+            f.write('if [ "$DMLC_TASK_ID" -lt "%d" ]; then\n'
+                    '  export DMLC_ROLE=server\nelse\n'
+                    '  export DMLC_ROLE=worker\nfi\n' % opts.num_servers)
+            f.write(" ".join(opts.command) + "\n")
+        os.chmod(runscript, os.stat(runscript).st_mode | stat.S_IEXEC)
+        n = opts.num_workers + opts.num_servers
+        cmd = ["qsub", "-cwd", "-t", f"1-{n}",
+               "-pe", "smp", str(opts.worker_cores),
+               "-N", opts.jobname, runscript]
+        logger.info("qsub: %s", " ".join(cmd))
+        subprocess.check_call(cmd)
+
+    submit_job(opts, fun_submit, wait=True)

@@ -1,0 +1,97 @@
+"""SSH backend: launch workers over ssh with env forwarding + workdir rsync.
+
+Reference: tracker/dmlc_tracker/ssh.py:13-85 — host-file parsing with optional
+ports (43-53), rsync of the working dir (13-21), env-forward whitelist
+including cloud credentials (26-27).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, List, Tuple
+
+from dmlc_core_tpu.tracker.submit import submit_job
+
+__all__ = ["submit", "parse_host_file"]
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+# env vars forwarded to remote workers (reference ssh.py:26-27 + TPU additions)
+FORWARD_ENV = [
+    "LD_LIBRARY_PATH", "PYTHONPATH", "DMLC_INTERFACE",
+    "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY", "AWS_SESSION_TOKEN",
+    "AWS_REGION", "GOOGLE_APPLICATION_CREDENTIALS",
+    "TPU_NAME", "JAX_PLATFORMS",
+]
+
+
+def parse_host_file(path: str, default_port: int = 22) -> List[Tuple[str, int]]:
+    """Lines of ``host`` or ``host:port`` (reference ssh.py:43-53)."""
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            if ":" in line:
+                host, port = line.rsplit(":", 1)
+                hosts.append((host, int(port)))
+            else:
+                hosts.append((line, default_port))
+    return hosts
+
+
+def sync_dir(local_dir: str, host: str, port: int, remote_dir: str) -> None:
+    """rsync the working directory to the remote host (reference ssh.py:13-21)."""
+    cmd = ["rsync", "-az", "--rsh", f"ssh -o StrictHostKeyChecking=no -p {port}",
+           local_dir + "/", f"{host}:{remote_dir}/"]
+    logger.debug("rsync: %s", " ".join(cmd))
+    subprocess.check_call(cmd)
+
+
+def _ssh_command(host: str, port: int, env: Dict[str, str], workdir: str,
+                 cmd: List[str]) -> List[str]:
+    exports = "; ".join(f"export {k}={_shquote(v)}" for k, v in env.items())
+    remote = f"{exports}; cd {_shquote(workdir)}; exec {' '.join(map(_shquote, cmd))}"
+    return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(port), host,
+            remote]
+
+
+def _shquote(s: str) -> str:
+    import shlex
+
+    return shlex.quote(str(s))
+
+
+def submit(opts) -> None:
+    assert opts.host_file, "--host-file is required for the ssh backend"
+    hosts = parse_host_file(opts.host_file, opts.ssh_port)
+
+    def fun_submit(envs: Dict[str, str]) -> None:
+        workdir = opts.sync_dst_dir or os.getcwd()
+        if opts.sync_dst_dir:
+            for host, port in set(hosts):
+                sync_dir(os.getcwd(), host, port, opts.sync_dst_dir)
+        threads = []
+        for i in range(opts.num_workers + opts.num_servers):
+            role = "server" if i < opts.num_servers else "worker"
+            taskid = i if role == "server" else i - opts.num_servers
+            host, port = hosts[i % len(hosts)]
+            env = dict(envs)
+            env["DMLC_ROLE"] = role
+            env["DMLC_TASK_ID"] = str(taskid)
+            for key in FORWARD_ENV:
+                if key in os.environ:
+                    env.setdefault(key, os.environ[key])
+            cmd = _ssh_command(host, port, env, workdir, opts.command)
+            t = threading.Thread(target=subprocess.check_call, args=(cmd,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+    submit_job(opts, fun_submit, wait=False)

@@ -1,0 +1,104 @@
+"""dmlc-submit: start tracker + coordinator, dispatch to a cluster backend.
+
+Reference: tracker/dmlc_tracker/submit.py:37-53 (dispatch) and
+tracker.py:410-433 (``submit()``: tracker startup + env assembly).
+
+Env contract handed to every worker (SURVEY.md §5.6):
+- ``DMLC_TRACKER_URI`` / ``DMLC_TRACKER_PORT``   — Rabit rendezvous (for
+  wire-compatible Rabit clients);
+- ``DMLC_NUM_WORKER`` / ``DMLC_NUM_SERVER``      — world shape;
+- ``DMLC_COORDINATOR_URI`` / ``DMLC_COORDINATOR_PORT`` — jax.distributed
+  coordinator (rank 0 hosts it; dmlc_core_tpu.collective.init consumes it);
+- per-task: ``DMLC_TASK_ID``, ``DMLC_ROLE``, ``DMLC_NUM_ATTEMPT``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import sys
+from typing import Callable, Dict, Optional
+
+from dmlc_core_tpu.tracker.rendezvous import PSTracker, RabitTracker, bind_free_port
+
+__all__ = ["submit_job", "main"]
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+def _default_host_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+def submit_job(opts, fun_submit: Callable[[Dict[str, str]], None],
+               wait: bool = True) -> RabitTracker:
+    """Start the tracker, build worker envs, and hand off to the backend's
+    ``fun_submit(envs)`` (reference tracker.py:410-433)."""
+    host_ip = opts.host_ip or _default_host_ip()
+    tracker = RabitTracker(host_ip, opts.num_workers)
+    tracker.start(opts.num_workers)
+
+    envs = {
+        "DMLC_NUM_WORKER": str(opts.num_workers),
+        "DMLC_NUM_SERVER": str(opts.num_servers),
+        "DMLC_JOB_CLUSTER": opts.cluster,
+    }
+    envs.update(tracker.worker_envs())
+    # allocate a coordinator port for jax.distributed (rank 0 binds it)
+    coord_sock, coord_port = bind_free_port(host_ip, 12321, 12999)
+    coord_sock.close()
+    envs["DMLC_COORDINATOR_URI"] = host_ip
+    envs["DMLC_COORDINATOR_PORT"] = str(coord_port)
+    if opts.num_servers > 0:
+        ps = PSTracker(host_ip, cmd=None)
+        envs.update(ps.worker_envs())
+    for kv in getattr(opts, "env", []):
+        key, _, value = kv.partition("=")
+        envs[key] = value
+
+    fun_submit(envs)
+    if wait:
+        tracker.join()
+    return tracker
+
+
+def main(argv=None) -> int:
+    from dmlc_core_tpu.tracker.opts import get_opts
+
+    opts = get_opts(argv)
+    logging.basicConfig(
+        level=getattr(logging, opts.log_level),
+        filename=opts.log_file,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    if not opts.command:
+        print("error: no worker command given", file=sys.stderr)
+        return 2
+    if opts.cluster == "local":
+        from dmlc_core_tpu.tracker import local as backend
+    elif opts.cluster == "ssh":
+        from dmlc_core_tpu.tracker import ssh as backend
+    elif opts.cluster == "mpi":
+        from dmlc_core_tpu.tracker import mpi as backend
+    elif opts.cluster == "sge":
+        from dmlc_core_tpu.tracker import sge as backend
+    elif opts.cluster == "tpu-vm":
+        from dmlc_core_tpu.tracker import tpu_vm as backend
+    else:
+        print(f"error: cluster backend {opts.cluster!r} is not available in "
+              f"this build (yarn/mesos are planned; see README)",
+              file=sys.stderr)
+        return 2
+    backend.submit(opts)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""TPU-VM backend: one process per TPU-VM host, jax.distributed wired.
+
+The new backend the north star asks for (BASELINE.json: "the dmlc_tracker /
+dmlc-submit launcher gains a tpu-vm backend"): launch the worker command on
+every host of a TPU pod slice and let ``dmlc_core_tpu.collective.init`` bring
+up ``jax.distributed`` from the env contract.
+
+Two launch paths:
+- with ``--host-file``: ssh to each TPU-VM worker (reuses the ssh machinery);
+- without: shell out to ``gcloud compute tpus tpu-vm ssh --worker=all`` using
+  ``TPU_NAME``/``TPU_ZONE`` env (the standard gcloud flow).
+
+On TPU the per-rank count is *hosts*, not chips: each process drives its local
+chips and jax handles the global device view, so ``--num-workers`` should be
+the host count of the slice (e.g. 2 for v5e-16).  Rank recovery keeps the
+reference's jobid semantics, but note SPMD reality (SURVEY.md §5.3): a lost
+host means the whole slice restarts and resumes from the latest checkpoint
+(bridge.checkpoint), not per-rank healing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict
+
+from dmlc_core_tpu.tracker.submit import submit_job
+from dmlc_core_tpu.tracker.ssh import FORWARD_ENV, _shquote, parse_host_file
+
+__all__ = ["submit"]
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+def _gcloud_cmd(env: Dict[str, str], command) -> list:
+    tpu_name = os.environ.get("TPU_NAME")
+    zone = os.environ.get("TPU_ZONE", "")
+    assert tpu_name, "tpu-vm backend needs --host-file or TPU_NAME env"
+    exports = "; ".join(f"export {k}={_shquote(v)}" for k, v in env.items())
+    remote = f"{exports}; {' '.join(map(_shquote, command))}"
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+           "--worker=all", f"--command={remote}"]
+    if zone:
+        cmd.append(f"--zone={zone}")
+    return cmd
+
+
+def submit(opts) -> None:
+    def fun_submit(envs: Dict[str, str]) -> None:
+        base_env = dict(envs)
+        for key in FORWARD_ENV:
+            if key in os.environ:
+                base_env.setdefault(key, os.environ[key])
+        if opts.host_file:
+            hosts = parse_host_file(opts.host_file, opts.ssh_port)
+            assert len(hosts) >= opts.num_workers, \
+                "host file has fewer hosts than --num-workers"
+            threads = []
+            for taskid in range(opts.num_workers):
+                host, port = hosts[taskid]
+                env = dict(base_env)
+                env["DMLC_ROLE"] = "worker"
+                env["DMLC_TASK_ID"] = str(taskid)
+                exports = "; ".join(
+                    f"export {k}={_shquote(v)}" for k, v in env.items())
+                workdir = opts.sync_dst_dir or "."
+                remote = (f"{exports}; cd {_shquote(workdir)}; "
+                          f"exec {' '.join(map(_shquote, opts.command))}")
+                cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-p",
+                       str(port), host, remote]
+                t = threading.Thread(target=subprocess.check_call, args=(cmd,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+        else:
+            # gcloud path: the TPU runtime provides per-host task ids via
+            # TPU_WORKER_ID; DMLC_TASK_ID defers to it on each host.
+            env = dict(base_env)
+            env["DMLC_ROLE"] = "worker"
+            env["DMLC_TASK_ID"] = "${TPU_WORKER_ID:-0}"
+            subprocess.check_call(_gcloud_cmd(env, opts.command))
+
+    submit_job(opts, fun_submit, wait=False)
