@@ -36,6 +36,34 @@ class CSVParser(TextParserBase):
         self.param.init(dict(args or {}), allow_unknown=True)
         CHECK_EQ(self.param.format, "csv")
 
+    def parse_chunk_native(self, data: bytes):
+        from dmlc_core_tpu import native_bridge
+
+        if not native_bridge.available():
+            return None
+        dense = native_bridge.parse_csv(data, nthread=max(self._nthread, 2))
+        return self._from_dense(dense)
+
+    def _from_dense(self, dense: np.ndarray) -> RowBlockContainer:
+        out = RowBlockContainer(self._index_dtype)
+        nrow, ncol = dense.shape
+        if nrow == 0:
+            return out
+        lc = self.param.label_column
+        if 0 <= lc < ncol:
+            labels = dense[:, lc].copy()
+            feats = np.delete(dense, lc, axis=1)
+        else:
+            labels = np.zeros(nrow, dtype=np.float32)
+            feats = dense
+        nfeat = feats.shape[1]
+        index = np.tile(np.arange(nfeat, dtype=self._index_dtype), nrow)
+        offset = np.arange(nrow + 1, dtype=np.int64) * nfeat
+        out.push_block(RowBlock(offset, labels, index,
+                                np.ascontiguousarray(feats).reshape(-1)))
+        out.max_index = max(nfeat - 1, 0)
+        return out
+
     def parse_block(self, data: bytes) -> RowBlockContainer:
         out = RowBlockContainer(self._index_dtype)
         rows = [r for r in data.splitlines() if r.strip()]

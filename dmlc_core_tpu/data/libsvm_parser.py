@@ -26,6 +26,22 @@ class LibSVMParser(TextParserBase):
         super().__init__(source, nthread)
         self._index_dtype = np.dtype(index_dtype)
 
+    def parse_chunk_native(self, data: bytes):
+        from dmlc_core_tpu import native_bridge
+
+        if not native_bridge.available():
+            return None
+        offset, label, weight, index, value = native_bridge.parse_libsvm(
+            data, nthread=max(self._nthread, 2))
+        out = RowBlockContainer(self._index_dtype)
+        if len(label):
+            out.push_block(RowBlock(offset, label,
+                                    index.astype(self._index_dtype, copy=False),
+                                    value, weight))
+            if index.size:
+                out.max_index = int(index.max())
+        return out
+
     def parse_block(self, data: bytes) -> RowBlockContainer:
         out = RowBlockContainer(self._index_dtype)
         tokens, counts = text_np.tokenize_ws(data)

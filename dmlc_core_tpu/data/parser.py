@@ -95,11 +95,20 @@ class TextParserBase(ParserImpl):
         """Parse one newline-delimited byte range (per-format)."""
         raise NotImplementedError
 
+    def parse_chunk_native(self, data: bytes) -> Optional[RowBlockContainer]:
+        """Whole-chunk parse via the C++ native core (dmlc_core_tpu/native);
+        None to fall back to the numpy path.  The native parser threads
+        internally (the reference's OpenMP team, text_parser.h:100-115)."""
+        return None
+
     def parse_next_blocks(self) -> Optional[List[RowBlockContainer]]:
         chunk = self._source.next_chunk()
         if chunk is None:
             return None
         self._bytes_read += len(chunk)
+        native = self.parse_chunk_native(chunk)
+        if native is not None:
+            return [native]
         ranges = self._split_ranges(chunk, self._nthread)
         if self._pool is None or len(ranges) <= 1:
             return [self.parse_block(r) for r in ranges]

@@ -1,0 +1,159 @@
+"""ctypes loader + wrappers for the C++ native core (native/parsers.cc).
+
+The reference's hot byte path is C++ (src/data/); here the same role is played
+by ``libdmlc_tpu_native.so``: multi-threaded chunk parsers returning numpy
+arrays.  The library is built from ``native/`` with ``make`` on first use
+(g++ is in the image); every caller falls back to the numpy path when the
+library is unavailable, so the pure-Python package remains fully functional.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["available", "parse_libsvm", "parse_libfm", "parse_csv",
+           "find_magic_positions"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdmlc_tpu_native.so")
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=300)
+        return os.path.exists(_SO_PATH)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DMLC_TPU_DISABLE_NATIVE"):
+            return None
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        for name in ("dmlc_tpu_parse_libsvm", "dmlc_tpu_parse_libfm",
+                     "dmlc_tpu_parse_csv"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_void_p
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+        lib.dmlc_tpu_result_dims.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.dmlc_tpu_error_msg.restype = ctypes.c_char_p
+        lib.dmlc_tpu_error_msg.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_result_fill.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_void_p] * 6
+        lib.dmlc_tpu_result_free.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_find_magic.restype = ctypes.c_int64
+        lib.dmlc_tpu_find_magic.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: Optional[np.ndarray]):
+    if arr is None or arr.size == 0:
+        return None
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def _parse_sparse(fn_name: str, data: bytes, nthread: int):
+    lib = _load()
+    assert lib is not None
+    handle = getattr(lib, fn_name)(data, len(data), nthread)
+    try:
+        n_rows = ctypes.c_int64()
+        nnz = ctypes.c_int64()
+        n_cols = ctypes.c_int64()
+        flags = ctypes.c_int32()
+        lib.dmlc_tpu_result_dims(handle, ctypes.byref(n_rows),
+                                 ctypes.byref(nnz), ctypes.byref(n_cols),
+                                 ctypes.byref(flags))
+        if n_rows.value < 0:
+            raise ValueError(lib.dmlc_tpu_error_msg(handle).decode())
+        nr, nz, fl = n_rows.value, nnz.value, flags.value
+        offset = np.empty(nr + 1, dtype=np.int64)
+        label = np.empty(nr, dtype=np.float32)
+        weight = np.empty(nr, dtype=np.float32) if (fl & 1) else None
+        index = np.empty(nz, dtype=np.uint32)
+        field = np.empty(nz, dtype=np.uint32) if (fl & 4) else None
+        value = np.empty(nz, dtype=np.float32) if (fl & 2) else None
+        lib.dmlc_tpu_result_fill(handle, _ptr(offset), _ptr(label),
+                                 _ptr(weight), _ptr(index), _ptr(field),
+                                 _ptr(value), None)
+        return offset, label, weight, index, field, value
+    finally:
+        lib.dmlc_tpu_result_free(handle)
+
+
+def parse_libsvm(data: bytes, nthread: int = 4):
+    """Chunk -> (offset, label, weight|None, index, value|None)."""
+    offset, label, weight, index, _, value = _parse_sparse(
+        "dmlc_tpu_parse_libsvm", data, nthread)
+    return offset, label, weight, index, value
+
+
+def parse_libfm(data: bytes, nthread: int = 4):
+    """Chunk -> (offset, label, weight|None, index, field, value)."""
+    offset, label, weight, index, field, value = _parse_sparse(
+        "dmlc_tpu_parse_libfm", data, nthread)
+    return offset, label, weight, index, field, value
+
+
+def parse_csv(data: bytes, nthread: int = 4) -> np.ndarray:
+    """Chunk -> dense [n_rows, n_cols] float32."""
+    lib = _load()
+    assert lib is not None
+    handle = lib.dmlc_tpu_parse_csv(data, len(data), nthread)
+    try:
+        n_rows = ctypes.c_int64()
+        nnz = ctypes.c_int64()
+        n_cols = ctypes.c_int64()
+        flags = ctypes.c_int32()
+        lib.dmlc_tpu_result_dims(handle, ctypes.byref(n_rows),
+                                 ctypes.byref(nnz), ctypes.byref(n_cols),
+                                 ctypes.byref(flags))
+        if n_rows.value < 0:
+            raise ValueError(lib.dmlc_tpu_error_msg(handle).decode())
+        dense = np.empty((n_rows.value, n_cols.value), dtype=np.float32)
+        lib.dmlc_tpu_result_fill(handle, None, None, None, None, None, None,
+                                 _ptr(dense.reshape(-1)))
+        return dense
+    finally:
+        lib.dmlc_tpu_result_free(handle)
+
+
+def find_magic_positions(data: bytes, magic: int, limit: int) -> np.ndarray:
+    """Aligned magic-word byte offsets (RecordIO writer escape scan)."""
+    lib = _load()
+    assert lib is not None
+    out = np.empty(limit, dtype=np.int64)
+    n = lib.dmlc_tpu_find_magic(data, len(data), magic, _ptr(out), limit)
+    return out[:min(n, limit)]
