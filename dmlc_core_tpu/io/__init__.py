@@ -22,3 +22,9 @@ from dmlc_core_tpu.io.recordio import (  # noqa: F401
 from dmlc_core_tpu.io.threadediter import ThreadedIter  # noqa: F401
 from dmlc_core_tpu.io.input_split import InputSplit, create_input_split  # noqa: F401
 from dmlc_core_tpu.io.uri_spec import URISpec  # noqa: F401
+
+# remote filesystems register themselves on import (the reference gates these
+# with DMLC_USE_S3/HDFS compile flags; here the gate is import/credential time)
+from dmlc_core_tpu.io import s3_filesys as _s3  # noqa: F401,E402
+from dmlc_core_tpu.io import http_filesys as _http  # noqa: F401,E402
+from dmlc_core_tpu.io import hdfs_filesys as _hdfs  # noqa: F401,E402

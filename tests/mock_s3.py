@@ -1,0 +1,162 @@
+"""In-process mock S3 server for filesystem tests (zero-egress substitute for
+the reference's real-bucket soak, test/README.md:1-30).
+
+Implements the subset our client uses: PUT/GET(Range)/HEAD objects,
+ListObjectsV2 with prefix+delimiter, and the multipart-upload flow
+(initiate / upload part / complete).  Verifies that every request carries a
+SigV4 Authorization header.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MockS3:
+    def __init__(self):
+        self.objects = {}      # (bucket, key) -> bytes
+        self.uploads = {}      # upload_id -> {"key":..., "parts": {n: bytes}}
+        self.next_upload = [0]
+        self.lock = threading.Lock()
+        self.requests = []     # (method, path) log
+
+    def start(self):
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _parse(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = parts[1] if len(parts) > 1 else ""
+                query = dict(urllib.parse.parse_qsl(parsed.query,
+                                                    keep_blank_values=True))
+                return bucket, key, query
+
+            def _reply(self, status, body=b"", headers=None):
+                headers = dict(headers or {})
+                self.send_response(status)
+                if "Content-Length" not in headers:
+                    headers["Content-Length"] = str(len(body))
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _check_auth(self):
+                auth = self.headers.get("Authorization", "")
+                if not auth.startswith("AWS4-HMAC-SHA256"):
+                    self._reply(403, b"<Error>missing sigv4</Error>")
+                    return False
+                return True
+
+            def do_HEAD(self):
+                if not self._check_auth():
+                    return
+                bucket, key, _ = self._parse()
+                store.requests.append(("HEAD", self.path))
+                data = store.objects.get((bucket, key))
+                if data is None:
+                    self._reply(404)
+                else:
+                    self._reply(200, b"", {"Content-Length": str(len(data))})
+                    return
+
+            def do_GET(self):
+                if not self._check_auth():
+                    return
+                bucket, key, query = self._parse()
+                store.requests.append(("GET", self.path))
+                if "list-type" in query:
+                    return self._list(bucket, query)
+                data = store.objects.get((bucket, key))
+                if data is None:
+                    return self._reply(404, b"<Error>NoSuchKey</Error>")
+                rng = self.headers.get("Range")
+                if rng:
+                    spec = rng.split("=")[1]
+                    start_s, end_s = spec.split("-")
+                    start = int(start_s)
+                    end = min(int(end_s), len(data) - 1) if end_s else len(data) - 1
+                    return self._reply(206, data[start:end + 1])
+                self._reply(200, data)
+
+            def _list(self, bucket, query):
+                prefix = query.get("prefix", "")
+                delim = query.get("delimiter", "")
+                contents, prefixes = [], set()
+                for (b, k), v in sorted(store.objects.items()):
+                    if b != bucket or not k.startswith(prefix):
+                        continue
+                    rest = k[len(prefix):]
+                    if delim and delim in rest:
+                        prefixes.add(prefix + rest.split(delim)[0] + delim)
+                    else:
+                        contents.append(
+                            f"<Contents><Key>{k}</Key>"
+                            f"<Size>{len(v)}</Size></Contents>")
+                cps = "".join(f"<CommonPrefixes><Prefix>{p}</Prefix>"
+                              f"</CommonPrefixes>" for p in sorted(prefixes))
+                body = (f"<ListBucketResult>{''.join(contents)}{cps}"
+                        f"</ListBucketResult>").encode()
+                self._reply(200, body)
+
+            def do_PUT(self):
+                if not self._check_auth():
+                    return
+                bucket, key, query = self._parse()
+                store.requests.append(("PUT", self.path))
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if "uploadId" in query:
+                    uid = query["uploadId"]
+                    part = int(query["partNumber"])
+                    with store.lock:
+                        store.uploads[uid]["parts"][part] = body
+                    return self._reply(200, b"", {"ETag": f'"part{part}"'})
+                store.objects[(bucket, key)] = body
+                self._reply(200, b"", {"ETag": '"etag"'})
+
+            def do_POST(self):
+                if not self._check_auth():
+                    return
+                bucket, key, query = self._parse()
+                store.requests.append(("POST", self.path))
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                if "uploads" in query:
+                    with store.lock:
+                        store.next_upload[0] += 1
+                        uid = f"upload-{store.next_upload[0]}"
+                        store.uploads[uid] = {"key": (bucket, key), "parts": {}}
+                    body = (f"<InitiateMultipartUploadResult>"
+                            f"<UploadId>{uid}</UploadId>"
+                            f"</InitiateMultipartUploadResult>").encode()
+                    return self._reply(200, body)
+                if "uploadId" in query:
+                    uid = query["uploadId"]
+                    with store.lock:
+                        up = store.uploads.pop(uid)
+                        data = b"".join(v for _, v in sorted(up["parts"].items()))
+                        store.objects[up["key"]] = data
+                    return self._reply(
+                        200, b"<CompleteMultipartUploadResult/>")
+                self._reply(400, b"<Error>bad post</Error>")
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
