@@ -1,0 +1,48 @@
+"""Framework interop boundary: zero-copy exchange with torch/numpy via dlpack.
+
+The reference ships a header-only Lua/Torch bridge (include/dmlc/lua.h:62-739)
+so DMLC libraries could exchange tensors with Torch7 plugins.  The modern
+equivalent of that FFI boundary is dlpack: jax.Array <-> torch.Tensor <->
+numpy without copies where layouts allow.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = ["to_torch", "from_torch", "to_numpy", "from_numpy"]
+
+
+def to_torch(x: Any):
+    """jax.Array/numpy -> torch.Tensor (dlpack zero-copy when possible)."""
+    import torch
+
+    try:
+        return torch.from_dlpack(x)
+    except Exception:
+        import numpy as np
+
+        return torch.from_numpy(np.asarray(x))
+
+
+def from_torch(t: Any):
+    """torch.Tensor -> jax.Array (dlpack zero-copy when device-compatible)."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        return jnp.from_dlpack(t)
+    except Exception:
+        return jnp.asarray(t.detach().cpu().numpy())
+
+
+def to_numpy(x: Any):
+    import numpy as np
+
+    return np.asarray(x)
+
+
+def from_numpy(a: Any):
+    import jax.numpy as jnp
+
+    return jnp.asarray(a)

@@ -1,0 +1,103 @@
+"""Tracing / profiling helpers (reference §5.1: timer.h + inline MB/s logs).
+
+The reference's observability is GetTime() + throughput prints; the TPU-native
+equivalents here:
+
+- :class:`ThroughputMeter` — the input-pipeline "N MB read, X MB/sec" meter
+  (reference src/data/basic_row_iter.h:70-75), reusable by any byte stage;
+- :func:`trace` — context manager around ``jax.profiler`` producing a
+  TensorBoard-loadable trace directory (device timelines, XLA ops);
+- :func:`annotate` — named TraceAnnotation spans visible in those traces;
+- :func:`device_timer` — ``block_until_ready``-bracketed wall timing for
+  honest device measurements (async dispatch otherwise lies).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+from dmlc_core_tpu.utils.logging import log_info
+
+__all__ = ["ThroughputMeter", "trace", "annotate", "device_timer"]
+
+
+class ThroughputMeter:
+    """Incremental byte/row throughput with periodic logging."""
+
+    def __init__(self, name: str = "pipeline", log_every_bytes: int = 10 << 20):
+        self.name = name
+        self._log_every = log_every_bytes
+        self.reset()
+
+    def reset(self) -> None:
+        self._start = time.perf_counter()
+        self._bytes = 0
+        self._rows = 0
+        self._next_log = self._log_every
+
+    def add(self, nbytes: int, nrows: int = 0) -> None:
+        self._bytes += nbytes
+        self._rows += nrows
+        if self._bytes >= self._next_log:
+            self._next_log += self._log_every
+            log_info(f"{self.name}: {self.mb:.0f} MB read, "
+                     f"{self.mb_per_sec:.2f} MB/sec")
+
+    @property
+    def elapsed(self) -> float:
+        return max(time.perf_counter() - self._start, 1e-9)
+
+    @property
+    def mb(self) -> float:
+        return self._bytes / (1 << 20)
+
+    @property
+    def mb_per_sec(self) -> float:
+        return self.mb / self.elapsed
+
+    @property
+    def rows_per_sec(self) -> float:
+        return self._rows / self.elapsed
+
+    def summary(self) -> str:
+        return (f"{self.name}: {self.mb:.2f} MB in {self.elapsed:.2f}s "
+                f"({self.mb_per_sec:.2f} MB/sec, "
+                f"{self.rows_per_sec:.0f} rows/sec)")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace (view with TensorBoard's profile plugin)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named span inside a profiler trace."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_timer(fn: Callable, *args: Any, iters: int = 1,
+                 warmup: int = 1) -> Tuple[Any, float]:
+    """(result, seconds-per-iter) with compile warmup and async-safe timing."""
+    import jax
+
+    out = None
+    for _ in range(max(warmup, 0)):
+        out = jax.block_until_ready(fn(*args))
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out = jax.block_until_ready(out)
+    return out, (time.perf_counter() - start) / max(iters, 1)

@@ -1,0 +1,114 @@
+"""Tests for concurrency primitives, interop boundary, and profiler helpers."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.utils.concurrency import (
+    BufferPool,
+    ConcurrentBlockingQueue,
+    ThreadLocalStore,
+)
+from dmlc_core_tpu.utils.profiler import ThroughputMeter, device_timer
+from dmlc_core_tpu.utils.common import hash_combine, split_string
+
+
+def test_blocking_queue_fifo():
+    q = ConcurrentBlockingQueue(max_size=4)
+    for i in range(4):
+        q.push(i)
+    assert [q.pop() for _ in range(4)] == [0, 1, 2, 3]
+
+
+def test_blocking_queue_priority():
+    q = ConcurrentBlockingQueue(priority=True)
+    q.push("low", priority=1)
+    q.push("high", priority=10)
+    q.push("mid", priority=5)
+    assert q.pop() == "high"
+    assert q.pop() == "mid"
+    assert q.pop() == "low"
+
+
+def test_blocking_queue_blocks_and_kills():
+    q = ConcurrentBlockingQueue(max_size=1)
+    q.push(1)
+    results = []
+
+    def producer():
+        q.push(2)  # blocks until pop
+        results.append("pushed")
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not results
+    assert q.pop() == 1
+    t.join(5)
+    assert results == ["pushed"]
+    # kill unblocks poppers with None
+    killer = threading.Timer(0.1, q.signal_for_kill)
+    killer.start()
+    assert q.pop() == 2
+    assert q.pop() is None
+
+
+def test_thread_local_store():
+    def factory():
+        return {"id": threading.get_ident()}
+
+    main_obj = ThreadLocalStore.get(factory)
+    assert ThreadLocalStore.get(factory) is main_obj
+    other = []
+
+    def worker():
+        other.append(ThreadLocalStore.get(factory))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert other[0] is not main_obj
+
+
+def test_buffer_pool():
+    pool = BufferPool(1024, max_cached=2)
+    a = pool.alloc()
+    assert len(a) == 1024
+    pool.free(a)
+    b = pool.alloc()
+    assert b is a  # recycled
+
+
+def test_interop_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    from dmlc_core_tpu.interop import from_torch, to_torch
+
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    x = from_torch(t)
+    np.testing.assert_allclose(np.asarray(x), t.numpy())
+    t2 = to_torch(np.asarray(x))
+    assert torch.equal(t2, t)
+
+
+def test_throughput_meter():
+    m = ThroughputMeter("test", log_every_bytes=1 << 30)
+    m.add(10 << 20, nrows=100)
+    assert m.mb == pytest.approx(10.0)
+    assert m.mb_per_sec > 0
+    assert "MB/sec" in m.summary()
+
+
+def test_device_timer():
+    import jax.numpy as jnp
+
+    out, secs = device_timer(lambda x: x * 2, jnp.ones(16), iters=2)
+    assert secs >= 0
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_common_helpers():
+    assert split_string("a;;b;c", ";") == ["a", "b", "c"]
+    assert hash_combine(1, 2) == hash_combine(1, 2)
+    assert hash_combine(1, 2) != hash_combine(2, 1)
