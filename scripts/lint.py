@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Lint driver (reference scripts/lint.py runs cpplint+pylint; here:
+compile-check + pyflakes when available + a few project rules)."""
+
+import ast
+import os
+import py_compile
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TARGETS = ["dmlc_core_tpu", "tests", "examples", "bench.py", "__graft_entry__.py"]
+
+
+def python_files():
+    for target in TARGETS:
+        path = os.path.join(ROOT, target)
+        if os.path.isfile(path):
+            yield path
+            continue
+        for dirpath, _, files in os.walk(path):
+            if "__pycache__" in dirpath:
+                continue
+            for name in files:
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def main() -> int:
+    errors = 0
+    files = list(python_files())
+    # 1) syntax
+    for path in files:
+        try:
+            py_compile.compile(path, doraise=True)
+        except py_compile.PyCompileError as exc:
+            print(f"SYNTAX {path}: {exc}")
+            errors += 1
+    # 2) pyflakes if present
+    try:
+        from pyflakes import api as pyflakes_api
+        from pyflakes.reporter import Reporter
+
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def write(self, text):
+                sys.stderr.write(text)
+                self.n += 1
+
+        counter = Counter()
+        rep = Reporter(counter, counter)
+        for path in files:
+            pyflakes_api.checkPath(path, rep)
+        errors += counter.n
+    except ImportError:
+        print("pyflakes not installed; syntax + AST rules only")
+    # 3) project rules: no bare print in the library (logging is the sink);
+    # CLI entry-point modules are exempt (they talk to the terminal)
+    cli_modules = {os.path.join(ROOT, "dmlc_core_tpu", "tracker", p)
+                   for p in ("submit.py", "launcher.py")}
+    for path in files:
+        if not path.startswith(os.path.join(ROOT, "dmlc_core_tpu")):
+            continue
+        if path in cli_modules:
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), path)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                print(f"RULE {path}:{node.lineno}: use utils.logging, not print()")
+                errors += 1
+    print(f"lint: {len(files)} files, {errors} problem(s)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
