@@ -28,3 +28,4 @@ from dmlc_core_tpu.io.uri_spec import URISpec  # noqa: F401
 from dmlc_core_tpu.io import s3_filesys as _s3  # noqa: F401,E402
 from dmlc_core_tpu.io import http_filesys as _http  # noqa: F401,E402
 from dmlc_core_tpu.io import hdfs_filesys as _hdfs  # noqa: F401,E402
+from dmlc_core_tpu.io import azure_filesys as _azure  # noqa: F401,E402
