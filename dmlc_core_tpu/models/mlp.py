@@ -1,0 +1,133 @@
+"""MLP classifier/regressor: the generic NN path over the bridge + mesh.
+
+The reference underpins MXNet's NN workloads; the rebuild's generic
+deep-learning path is this model: dense batches from the data pipeline, bf16
+matmuls on the MXU, optax optimizers, data-parallel batches with optional
+tensor-parallel hidden layers (weights sharding-constrained over a "model"
+mesh axis so XLA partitions the matmuls and inserts the collectives).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dmlc_core_tpu.bridge.batching import DenseBatch
+from dmlc_core_tpu.param import Parameter, field
+from dmlc_core_tpu.utils.logging import CHECK
+
+__all__ = ["MLPParam", "MLP"]
+
+
+class MLPParam(Parameter):
+    num_feature = field(int, lower=1, help="input dimension")
+    hidden = field(str, default="128,128",
+                   help="comma-separated hidden layer widths")
+    num_class = field(int, default=2, lower=1,
+                      help="output classes (1 = regression)")
+    learning_rate = field(float, default=1e-3, lower=0.0, help="adam lr")
+    activation = field(str, default="relu", enum=["relu", "tanh", "gelu"],
+                       help="nonlinearity")
+    bf16 = field(bool, default=True, help="bfloat16 matmuls (MXU-friendly)")
+
+    def hidden_sizes(self) -> List[int]:
+        return [int(w) for w in self.hidden.split(",") if w.strip()]
+
+
+class MLP:
+    """Plain-jax MLP with optax optimizer state."""
+
+    def __init__(self, param: MLPParam, model_axis: Optional[str] = None):
+        self.param = param
+        self.model_axis = model_axis
+        sizes = [param.num_feature] + param.hidden_sizes()
+        out_dim = 1 if param.num_class == 1 else param.num_class
+        self._dims = list(zip(sizes, sizes[1:] + [out_dim]))
+        self._dims[-1] = (sizes[-1], out_dim)
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(seed)
+        layers = []
+        sizes = [self.param.num_feature] + self.param.hidden_sizes()
+        out_dim = 1 if self.param.num_class == 1 else self.param.num_class
+        dims = list(zip(sizes, sizes[1:])) + [(sizes[-1], out_dim)]
+        for fan_in, fan_out in dims:
+            scale = np.sqrt(2.0 / fan_in)
+            layers.append({
+                "w": jnp.asarray(rng.normal(0, scale, (fan_in, fan_out))
+                                 .astype(np.float32)),
+                "b": jnp.zeros((fan_out,), jnp.float32),
+            })
+        return {"layers": layers}
+
+    def _apply(self, params, x):
+        import jax
+        import jax.numpy as jnp
+
+        act = {"relu": jax.nn.relu, "tanh": jnp.tanh, "gelu": jax.nn.gelu}[
+            self.param.activation]
+        compute_dtype = jnp.bfloat16 if self.param.bf16 else jnp.float32
+        h = x.astype(compute_dtype)
+        layers = params["layers"]
+        for i, layer in enumerate(layers):
+            w = layer["w"].astype(compute_dtype)
+            if self.model_axis is not None and 0 < i < len(layers) - 1:
+                from jax.sharding import PartitionSpec as P
+
+                w = jax.lax.with_sharding_constraint(
+                    w, P(None, self.model_axis))
+            h = h @ w + layer["b"].astype(compute_dtype)
+            if i < len(layers) - 1:
+                h = act(h)
+        return h.astype(jnp.float32)
+
+    def _loss(self, params, batch: DenseBatch):
+        import jax
+        import jax.numpy as jnp
+
+        logits = self._apply(params, batch.x)
+        w = batch.weight
+        denom = jnp.maximum(w.sum(), 1.0)
+        if self.param.num_class == 1:
+            err = (logits[:, 0] - batch.label) ** 2
+            return jnp.sum(err * w) / denom
+        labels = batch.label.astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        return jnp.sum(nll * w) / denom
+
+    @functools.lru_cache(maxsize=None)
+    def _train_step(self):
+        import jax
+        import optax
+
+        tx = optax.adam(self.param.learning_rate)
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(self._loss)(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1)), tx
+
+    def init_optimizer(self, params):
+        _, tx = self._train_step()
+        return tx.init(params)
+
+    def train_step(self, params, opt_state, batch: DenseBatch):
+        fn, _ = self._train_step()
+        return fn(params, opt_state, batch)
+
+    def predict(self, params, x):
+        import jax
+        import jax.numpy as jnp
+
+        logits = jax.jit(self._apply)(params, jnp.asarray(x))
+        if self.param.num_class == 1:
+            return logits[:, 0]
+        return jax.nn.softmax(logits, axis=-1)
