@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Input-pipeline benchmark harnesses (the reference's tier-2 CLI tests:
+split_read_test.cc, libsvm_parser_test.cc — they print MB/sec).
+
+    python benchmarks/bench_pipeline.py split  <uri> [part] [nparts] [type]
+    python benchmarks/bench_pipeline.py parser <uri> [format]
+    python benchmarks/bench_pipeline.py gen    <path> [rows] [features]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_split(uri, part=0, nparts=1, type_="text"):
+    from dmlc_core_tpu.io.input_split import create_input_split
+    from dmlc_core_tpu.utils.profiler import ThroughputMeter
+
+    split = create_input_split(uri, int(part), int(nparts), type_)
+    meter = ThroughputMeter("split-read")
+    nrec = 0
+    while True:
+        chunk = split.next_chunk()
+        if chunk is None:
+            break
+        meter.add(len(chunk))
+    split.close()
+    print(meter.summary())
+
+
+def bench_parser(uri, fmt="auto"):
+    from dmlc_core_tpu.data.factory import create_parser
+    from dmlc_core_tpu.utils.profiler import ThroughputMeter
+
+    parser = create_parser(uri, type=fmt)
+    meter = ThroughputMeter("parse")
+    rows = 0
+    for block in parser:
+        rows += block.size
+        meter.add(0, nrows=block.size)
+    meter.add(parser.bytes_read())
+    print(f"{rows} rows; {meter.summary()}")
+
+
+def gen(path, rows=1_000_000, features=28):
+    """Synthetic HIGGS-like libsvm file for benchmarking."""
+    import numpy as np
+
+    rows, features = int(rows), int(features)
+    rng = np.random.RandomState(0)
+    with open(path, "w") as f:
+        for start in range(0, rows, 10000):
+            n = min(10000, rows - start)
+            x = rng.randn(n, features)
+            y = rng.randint(0, 2, n)
+            lines = []
+            for i in range(n):
+                feats = " ".join(f"{j}:{x[i, j]:.4f}" for j in range(features))
+                lines.append(f"{y[i]} {feats}")
+            f.write("\n".join(lines) + "\n")
+    print(f"wrote {rows} rows to {path} "
+          f"({os.path.getsize(path) / (1 << 20):.1f} MB)")
+
+
+def main():
+    if len(sys.argv) < 3:
+        print(__doc__)
+        return 2
+    cmd, args = sys.argv[1], sys.argv[2:]
+    {"split": bench_split, "parser": bench_parser, "gen": gen}[cmd](*args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

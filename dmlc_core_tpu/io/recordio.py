@@ -97,6 +97,27 @@ class RecordIOWriter:
         return self._stream.tell()
 
 
+class IndexedRecordIOWriter(RecordIOWriter):
+    """RecordIO writer that also tracks the index-file entries consumed by
+    :class:`dmlc_core_tpu.io.input_split.IndexedRecordIOSplitter` (text lines
+    of ``<record-id> <byte-offset>``, reference indexed_recordio_split.cc
+    ReadIndexFile)."""
+
+    def __init__(self, stream: Stream):
+        super().__init__(stream)
+        self.offsets: List[int] = []
+        self._next_id = 0
+
+    def write_record(self, data: bytes) -> None:
+        self.offsets.append(self.tell())
+        super().write_record(data)
+        self._next_id += 1
+
+    def save_index(self, index_stream: Stream) -> None:
+        text = "".join(f"{i} {off}\n" for i, off in enumerate(self.offsets))
+        index_stream.write(text.encode("ascii"))
+
+
 class RecordIOReader:
     """Sequentially read records from a stream (reference recordio.cc:53-83)."""
 

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Pack records into (indexed) RecordIO files.
+
+The reference ecosystem's ``im2rec``-style packing tool: reads newline
+records from a text source (or length-prefixed blobs from stdin) and writes a
+``.rec`` file plus an optional ``.idx`` index usable with
+``type="indexed_recordio"`` splits::
+
+    python tools/make_recordio.py --input data.txt --output data.rec --index data.idx
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", required=True, help="text file; one record per line")
+    ap.add_argument("--output", required=True, help="output .rec URI")
+    ap.add_argument("--index", default="", help="optional .idx output URI")
+    args = ap.parse_args()
+
+    from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter, RecordIOWriter
+    from dmlc_core_tpu.io.stream import create_stream
+
+    fo = create_stream(args.output, "w")
+    writer = IndexedRecordIOWriter(fo) if args.index else RecordIOWriter(fo)
+    n = 0
+    with open(args.input, "rb") as fi:
+        for line in fi:
+            writer.write_record(line.rstrip(b"\n"))
+            n += 1
+    fo.close()
+    if args.index:
+        with create_stream(args.index, "w") as idx:
+            writer.save_index(idx)
+    print(f"wrote {n} records to {args.output}"
+          + (f" (+ index {args.index})" if args.index else ""))
+
+
+if __name__ == "__main__":
+    main()
