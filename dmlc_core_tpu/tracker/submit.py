@@ -91,9 +91,11 @@ def main(argv=None) -> int:
         from dmlc_core_tpu.tracker import sge as backend
     elif opts.cluster == "tpu-vm":
         from dmlc_core_tpu.tracker import tpu_vm as backend
+    elif opts.cluster == "yarn":
+        from dmlc_core_tpu.tracker import yarn as backend
     else:
         print(f"error: cluster backend {opts.cluster!r} is not available in "
-              f"this build (yarn/mesos are planned; see README)",
+              f"this build (mesos is EOL upstream; see PARITY.md)",
               file=sys.stderr)
         return 2
     backend.submit(opts)

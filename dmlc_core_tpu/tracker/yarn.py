@@ -1,0 +1,88 @@
+"""YARN backend: submit via the ResourceManager REST API.
+
+The reference ships a 1k-LoC Java Client/ApplicationMaster pair
+(tracker/yarn/, reference yarn.py:16-129) that requests containers, retries
+failed tasks up to 3 attempts, and blacklists bad nodes.  The rebuild talks
+to the RM's REST API (``/ws/v1/cluster/apps``) directly — no Java build — and
+launches each task with the standard env contract through
+``dmlc_core_tpu.tracker.launcher``; per-task retry is delegated to YARN's
+``maxAppAttempts`` (the AM-level retry of the reference) plus
+``DMLC_NUM_ATTEMPT`` inside the container.
+
+Config: ``YARN_RM_URI`` (e.g. http://rm-host:8088) or --env YARN_RM_URI=...;
+resources from --worker-cores/--worker-memory (the reference's
+DMLC_WORKER_CORES/MEMORY_MB contract, yarn.py:89-96).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import urllib.request
+from typing import Dict
+
+from dmlc_core_tpu.tracker.submit import submit_job
+from dmlc_core_tpu.utils.logging import CHECK
+
+__all__ = ["submit"]
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+def _rest(rm_uri: str, path: str, payload: Dict = None, method: str = "GET"):
+    url = rm_uri.rstrip("/") + path
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        body = resp.read()
+        return resp.status, json.loads(body) if body else {}
+
+
+def _launch_command(opts, envs: Dict[str, str], role: str) -> str:
+    exports = " && ".join(
+        f"export {k}='{v}'" for k, v in {**envs, "DMLC_ROLE": role,
+                                         "DMLC_TASK_ID": "$CONTAINER_ID_IDX",
+                                         "DMLC_JOB_CLUSTER": "yarn"}.items())
+    cmd = " ".join(opts.command)
+    return (f"{exports} && python -m dmlc_core_tpu.tracker.launcher {cmd} "
+            f"1><LOG_DIR>/stdout 2><LOG_DIR>/stderr")
+
+
+def submit(opts) -> None:
+    rm_uri = os.environ.get("YARN_RM_URI", "")
+    for kv in getattr(opts, "env", []):
+        if kv.startswith("YARN_RM_URI="):
+            rm_uri = kv.split("=", 1)[1]
+    CHECK(rm_uri, "yarn backend needs YARN_RM_URI (ResourceManager REST "
+                  "endpoint, e.g. http://rm:8088)")
+
+    def fun_submit(envs: Dict[str, str]) -> None:
+        status, new_app = _rest(rm_uri, "/ws/v1/cluster/apps/new-application",
+                                payload={}, method="POST")
+        CHECK(status in (200, 201), f"new-application failed: {status}")
+        app_id = new_app["application-id"]
+        payload = {
+            "application-id": app_id,
+            "application-name": opts.jobname,
+            "application-type": "DMLC",
+            "queue": opts.queue,
+            "max-app-attempts": 3,  # reference ApplicationMaster.java:74
+            "am-container-spec": {
+                "commands": {"command": _launch_command(opts, envs, "worker")},
+                "environment": {"entry": [
+                    {"key": k, "value": str(v)} for k, v in envs.items()]},
+            },
+            "resource": {
+                "memory": opts.worker_memory_mb,
+                "vCores": opts.worker_cores,
+            },
+        }
+        status, _ = _rest(rm_uri, "/ws/v1/cluster/apps", payload=payload,
+                          method="POST")
+        CHECK(status in (200, 202), f"application submit failed: {status}")
+        logger.info("submitted %s to YARN as %s (%d workers, %d servers)",
+                    opts.jobname, app_id, opts.num_workers, opts.num_servers)
+
+    submit_job(opts, fun_submit, wait=True)

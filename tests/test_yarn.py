@@ -1,0 +1,90 @@
+"""YARN backend test against a mocked ResourceManager REST endpoint."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dmlc_core_tpu.tracker.opts import get_opts
+
+
+class MockRM:
+    def __init__(self):
+        self.submissions = []
+
+    def start(self):
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if self.path.endswith("new-application"):
+                    out = json.dumps({"application-id": "app_123",
+                                      "maximum-resource-capability":
+                                          {"memory": 8192, "vCores": 4}}).encode()
+                    self.send_response(200)
+                elif self.path.endswith("/apps"):
+                    store.submissions.append(json.loads(body))
+                    out = b""
+                    self.send_response(202)
+                else:
+                    out = b""
+                    self.send_response(404)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def test_yarn_submit(monkeypatch):
+    rm = MockRM().start()
+    try:
+        monkeypatch.setenv("YARN_RM_URI", f"http://127.0.0.1:{rm.port}")
+        from dmlc_core_tpu.tracker import yarn
+
+        opts = get_opts(["--cluster", "yarn", "--num-workers", "4",
+                         "--worker-memory", "2g", "--worker-cores", "2",
+                         "--jobname", "test-job", "--",
+                         "python", "train.py"])
+
+        # run the submission but don't wait on the tracker (no real workers)
+        from dmlc_core_tpu.tracker import submit as submit_mod
+
+        orig = submit_mod.submit_job
+
+        def no_wait(opts_, fun, wait=True):
+            return orig(opts_, fun, wait=False)
+
+        monkeypatch.setattr(yarn, "submit_job", no_wait)
+        yarn.submit(opts)
+        assert len(rm.submissions) == 1
+        sub = rm.submissions[0]
+        assert sub["application-id"] == "app_123"
+        assert sub["application-name"] == "test-job"
+        assert sub["max-app-attempts"] == 3
+        assert sub["resource"] == {"memory": 2048, "vCores": 2}
+        env = {e["key"]: e["value"]
+               for e in sub["am-container-spec"]["environment"]["entry"]}
+        assert env["DMLC_NUM_WORKER"] == "4"
+        assert "DMLC_TRACKER_URI" in env
+        assert "DMLC_COORDINATOR_PORT" in env
+        cmd = sub["am-container-spec"]["commands"]["command"]
+        assert "dmlc_core_tpu.tracker.launcher" in cmd
+        assert "python train.py" in cmd
+    finally:
+        rm.stop()
