@@ -257,3 +257,110 @@ class GBDT:
         if self.param.objective == "logistic":
             return 1.0 / (1.0 + jnp.exp(-margin))
         return margin
+
+    # -- training with eval / early stopping ----------------------------------
+    @functools.lru_cache(maxsize=None)
+    def _tree_margin_fn(self):
+        import jax
+
+        d = self.param.max_depth
+
+        def one_tree(sf, sb, lv, bins):
+            return _predict_tree(sf, sb, lv, bins, d)
+
+        return jax.jit(one_tree)
+
+    def fit_with_eval(self, bins, label, eval_bins=None, eval_label=None,
+                      weight=None, early_stopping_rounds: int = 0):
+        """Round-by-round boosting with validation logloss tracking.
+
+        Returns (ensemble, history) where history is a list of per-round dicts
+        (train margin loss and, when an eval set is given, eval loss).  With
+        ``early_stopping_rounds`` > 0, stops when eval loss hasn't improved
+        for that many rounds and truncates the ensemble to the best round.
+        """
+        import jax.numpy as jnp
+
+        weight = (jnp.ones(bins.shape[0], jnp.float32)
+                  if weight is None else jnp.asarray(weight))
+        bins = jnp.asarray(bins)
+        label = jnp.asarray(label, jnp.float32)
+        margin = jnp.zeros(bins.shape[0], jnp.float32)
+        eval_margin = None
+        if eval_bins is not None:
+            eval_bins = jnp.asarray(eval_bins)
+            eval_label = jnp.asarray(eval_label, jnp.float32)
+            eval_margin = jnp.zeros(eval_bins.shape[0], jnp.float32)
+        trees = []
+        history = []
+        best_round, best_loss = -1, float("inf")
+        tree_margin = self._tree_margin_fn()
+        for r in range(self.param.num_boost_round):
+            margin, (sf, sb, lv) = self.boost_round(margin, bins, label, weight)
+            trees.append((sf, sb, lv))
+            entry = {"round": r,
+                     "train_loss": float(_logloss(margin, label,
+                                                  self.param.objective))}
+            if eval_margin is not None:
+                eval_margin = eval_margin + tree_margin(sf, sb, lv, eval_bins)
+                eval_loss = float(_logloss(eval_margin, eval_label,
+                                           self.param.objective))
+                entry["eval_loss"] = eval_loss
+                if eval_loss < best_loss - 1e-9:
+                    best_loss, best_round = eval_loss, r
+                elif (early_stopping_rounds
+                      and r - best_round >= early_stopping_rounds):
+                    trees = trees[:best_round + 1]
+                    history.append(entry)
+                    break
+            history.append(entry)
+        sfs = jnp.stack([t[0] for t in trees])
+        sbs = jnp.stack([t[1] for t in trees])
+        lvs = jnp.stack([t[2] for t in trees])
+        return TreeEnsemble(sfs, sbs, lvs), history
+
+    # -- introspection / persistence ------------------------------------------
+    def feature_importance(self, ensemble: TreeEnsemble,
+                           kind: str = "weight") -> np.ndarray:
+        """Per-feature importance: 'weight' = number of splits using the
+        feature (the XGBoost default importance_type)."""
+        CHECK(kind == "weight", "only 'weight' importance is implemented")
+        sf = np.asarray(ensemble.split_feat).reshape(-1)
+        counts = np.bincount(sf[sf >= 0], minlength=self.num_feature)
+        return counts.astype(np.float64)
+
+    def save_model(self, uri: str, ensemble: TreeEnsemble) -> None:
+        """Persist the model + binning boundaries to any URI."""
+        from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
+
+        CHECK(self.boundaries is not None, "model has no bin boundaries")
+        save_checkpoint(uri, {
+            "split_feat": np.asarray(ensemble.split_feat),
+            "split_bin": np.asarray(ensemble.split_bin),
+            "leaf_value": np.asarray(ensemble.leaf_value),
+            "boundaries": np.asarray(self.boundaries),
+        })
+
+    def load_model(self, uri: str) -> TreeEnsemble:
+        from dmlc_core_tpu.bridge.checkpoint import load_checkpoint
+
+        flat = load_checkpoint(uri)
+
+        # keys are keystr paths like "['split_feat']"
+        def get(name):
+            for k, v in flat.items():
+                if name in k:
+                    return v
+            raise KeyError(name)
+
+        self.boundaries = np.asarray(get("boundaries"), dtype=np.float32)
+        return TreeEnsemble(get("split_feat"), get("split_bin"),
+                            get("leaf_value"))
+
+
+def _logloss(margin, label, objective: str):
+    import jax.numpy as jnp
+
+    if objective == "logistic":
+        return jnp.mean(jnp.logaddexp(0.0, margin) - label * margin)
+    return jnp.mean((margin - label) ** 2)

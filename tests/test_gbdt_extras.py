@@ -1,0 +1,81 @@
+"""GBDT eval/early-stopping/importance/persistence tests."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+
+def make_data(n, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 4).astype(np.float32)
+    y = ((x[:, 0] * x[:, 1] + 0.2 * rng.randn(n)) > 0).astype(np.float32)
+    return x, y
+
+
+@pytest.fixture(scope="module")
+def model_and_data():
+    x, y = make_data(3000, 0)
+    xv, yv = make_data(1000, 1)
+    param = GBDTParam(num_boost_round=30, max_depth=3, num_bins=32,
+                      learning_rate=0.3)
+    model = GBDT(param, num_feature=4)
+    model.make_bins(x)
+    return model, np.asarray(model.bin_features(x)), y, \
+        np.asarray(model.bin_features(xv)), yv
+
+
+def test_fit_with_eval_tracks_losses(model_and_data):
+    model, bins, y, bins_v, yv = model_and_data
+    ensemble, history = model.fit_with_eval(bins, y, bins_v, yv)
+    assert len(history) == 30
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    assert "eval_loss" in history[0]
+    # eval margins accumulated incrementally must match full predict
+    full = np.asarray(model.predict_margin(ensemble, bins_v))
+    import jax.numpy as jnp
+
+    incr = np.zeros(len(yv), np.float32)
+    tm = model._tree_margin_fn()
+    for t in range(ensemble.num_trees):
+        incr += np.asarray(tm(ensemble.split_feat[t], ensemble.split_bin[t],
+                              ensemble.leaf_value[t], jnp.asarray(bins_v)))
+    np.testing.assert_allclose(full, incr, rtol=1e-4, atol=1e-5)
+
+
+def test_early_stopping_truncates(model_and_data):
+    model, bins, y, bins_v, yv = model_and_data
+    ensemble, history = model.fit_with_eval(
+        bins, y, bins_v, yv, early_stopping_rounds=3)
+    # either it ran the full 30 rounds or stopped early with a truncated model
+    if len(history) < 30:
+        best = min(h["eval_loss"] for h in history)
+        assert ensemble.num_trees <= len(history)
+        kept_losses = [h["eval_loss"] for h in history[:ensemble.num_trees]]
+        assert min(kept_losses) == pytest.approx(best)
+
+
+def test_feature_importance(model_and_data):
+    model, bins, y, _, _ = model_and_data
+    ensemble, _ = model.fit_with_eval(bins, y)
+    imp = model.feature_importance(ensemble)
+    assert imp.shape == (4,)
+    # features 0 and 1 drive the XOR target; they must dominate
+    assert imp[0] + imp[1] > imp[2] + imp[3]
+
+
+def test_save_load_model(model_and_data, tmp_path):
+    model, bins, y, bins_v, _ = model_and_data
+    ensemble, _ = model.fit_with_eval(bins, y)
+    uri = str(tmp_path / "gbdt.bin")
+    model.save_model(uri, ensemble)
+
+    fresh = GBDT(model.param, num_feature=4)
+    loaded = fresh.load_model(uri)
+    np.testing.assert_array_equal(np.asarray(loaded.split_feat),
+                                  np.asarray(ensemble.split_feat))
+    np.testing.assert_allclose(np.asarray(fresh.boundaries),
+                               np.asarray(model.boundaries))
+    p1 = np.asarray(model.predict_margin(ensemble, bins_v))
+    p2 = np.asarray(fresh.predict_margin(loaded, bins_v))
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
