@@ -1,0 +1,63 @@
+"""Smoke tests running the example entry points end-to-end (CPU)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(script, args, env_extra=None):
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    # examples force cpu themselves only via env; patch through jax config
+    code = (f"import jax; jax.config.update('jax_platforms','cpu'); "
+            f"import runpy, sys; sys.argv = {[script] + args!r}; "
+            f"runpy.run_path({script!r}, run_name='__main__')")
+    return subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+
+
+@pytest.mark.slow
+def test_train_logreg_example(tmp_path):
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(400):
+        x = rng.randn(8)
+        y = int(x[0] + x[1] > 0)
+        feats = " ".join(f"{j}:{x[j]:.4f}" for j in range(8))
+        lines.append(f"{y} {feats}")
+    data = tmp_path / "train.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+    proc = run_example(os.path.join(REPO, "examples", "train_logreg.py"),
+                       ["--data", str(data), "--num-feature", "8",
+                        "--batch-size", "64", "--epochs", "1"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "loss=" in proc.stderr or "loss=" in proc.stdout
+
+
+@pytest.mark.slow
+def test_train_gbdt_example(tmp_path):
+    rng = np.random.RandomState(1)
+    rows = []
+    for i in range(600):
+        x = rng.randn(4)
+        y = int(x[0] * x[1] > 0)
+        rows.append(",".join([str(y)] + [f"{v:.4f}" for v in x]))
+    data = tmp_path / "train.csv"
+    data.write_text("\n".join(rows) + "\n")
+    ckpt = tmp_path / "model.bin"
+    proc = run_example(os.path.join(REPO, "examples", "train_gbdt.py"),
+                       ["--data", f"{data}?format=csv&label_column=0",
+                        "--num-feature", "4", "--rounds", "5",
+                        "--max-depth", "3", "--num-bins", "16",
+                        "--checkpoint", str(ckpt)])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "rows/sec" in proc.stdout
+    assert ckpt.exists()
