@@ -30,7 +30,9 @@ from typing import Any, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from dmlc_core_tpu.ops.histogram import apply_bins, grad_histogram, quantile_boundaries
+from dmlc_core_tpu.ops.histogram import (apply_bins, bin_onehot, grad_histogram,
+                                         quantile_boundaries,
+                                         resolve_hist_method)
 from dmlc_core_tpu.param import Parameter, field
 from dmlc_core_tpu.utils.logging import CHECK
 
@@ -48,6 +50,10 @@ class GBDTParam(Parameter):
                              help="minimum hessian sum per child")
     objective = field(str, default="logistic", enum=["logistic", "squared"],
                       help="loss")
+    hist_method = field(str, default="auto",
+                        enum=["auto", "onehot", "scatter"],
+                        help="histogram algorithm: one-hot MXU matmul (TPU) "
+                             "or segment-sum scatter (CPU)")
 
 
 class TreeEnsemble(NamedTuple):
@@ -73,7 +79,8 @@ def _grad_hess(margin, label, objective: str):
 
 def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 min_child_weight: float, learning_rate: float,
-                model_axis: Optional[str] = None):
+                model_axis: Optional[str] = None, method: str = "scatter",
+                onehot=None):
     """Grow one tree level-by-level; returns (split_feat, split_bin, leaf_value,
     margin_delta).  Pure jax, shapes static in (max_depth, num_bins, F)."""
     import jax.numpy as jnp
@@ -88,7 +95,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         n_nodes = 2 ** depth
         level_off = n_nodes - 1
         G, H = grad_histogram(bins, node, g, h, n_nodes, num_bins,
-                              model_axis=model_axis)     # [n, F, nbins]
+                              model_axis=model_axis, method=method,
+                              onehot=onehot)             # [n, F, nbins]
         GL = jnp.cumsum(G, axis=-1)
         HL = jnp.cumsum(H, axis=-1)
         GT = GL[..., -1:]
@@ -121,8 +129,17 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     import jax
 
     n_leaf = 2 ** max_depth
-    Gl = jax.ops.segment_sum(g, node, num_segments=n_leaf)
-    Hl = jax.ops.segment_sum(h, node, num_segments=n_leaf)
+    if method == "onehot":
+        # leaf sums as a (tiny) f32 matmul — TPU scatter-adds serialise
+        leafhot = (node[:, None] == jnp.arange(n_leaf, dtype=node.dtype)
+                   ).astype(jnp.float32)                 # [B, n_leaf]
+        gh = jnp.stack([g, h], axis=1)                   # [B, 2]
+        sums = jax.lax.dot_general(leafhot, gh, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        Gl, Hl = sums[:, 0], sums[:, 1]
+    else:
+        Gl = jax.ops.segment_sum(g, node, num_segments=n_leaf)
+        Hl = jax.ops.segment_sum(h, node, num_segments=n_leaf)
     leaf_value = (-Gl / (Hl + reg_lambda)) * learning_rate
     margin_delta = leaf_value[node]
     return split_feat, split_bin, leaf_value, margin_delta
@@ -167,8 +184,11 @@ class GBDT:
         return apply_bins(x, self.boundaries)
 
     # -- compiled round/predict ----------------------------------------------
+    def _method(self, *arrays) -> str:
+        return resolve_hist_method(self.param.hist_method, *arrays)
+
     @functools.lru_cache(maxsize=None)
-    def _round_fn(self):
+    def _round_fn(self, method: str = "scatter"):
         import jax
 
         p = self.param
@@ -177,15 +197,18 @@ class GBDT:
             g, h = _grad_hess(margin, label, p.objective)
             g = g * weight
             h = h * weight
+            onehot = (bin_onehot(bins, p.num_bins)
+                      if method == "onehot" else None)
             sf, sb, lv, delta = _build_tree(
                 bins, g, h, p.max_depth, p.num_bins, p.reg_lambda,
-                p.min_child_weight, p.learning_rate, self.model_axis)
+                p.min_child_weight, p.learning_rate, self.model_axis,
+                method=method, onehot=onehot)
             return margin + delta, (sf, sb, lv)
 
         return jax.jit(one_round)
 
     @functools.lru_cache(maxsize=None)
-    def _fit_fn(self, num_rounds: int):
+    def _fit_fn(self, num_rounds: int, method: str = "scatter"):
         import jax
         import jax.lax as lax
 
@@ -195,6 +218,10 @@ class GBDT:
             import jax.numpy as jnp
 
             B = bins.shape[0]
+            # the bin one-hot (the matmul RHS) is invariant across rounds and
+            # levels: materialise once, outside the scan
+            onehot = (bin_onehot(bins, p.num_bins)
+                      if method == "onehot" else None)
 
             def body(margin, _):
                 g, h = _grad_hess(margin, label, p.objective)
@@ -202,7 +229,8 @@ class GBDT:
                 h = h * weight
                 sf, sb, lv, delta = _build_tree(
                     bins, g, h, p.max_depth, p.num_bins, p.reg_lambda,
-                    p.min_child_weight, p.learning_rate, self.model_axis)
+                    p.min_child_weight, p.learning_rate, self.model_axis,
+                    method=method, onehot=onehot)
                 return margin + delta, (sf, sb, lv)
 
             margin0 = jnp.zeros((B,), dtype=jnp.float32)
@@ -240,12 +268,14 @@ class GBDT:
 
         weight = (jnp.ones(bins.shape[0], jnp.float32)
                   if weight is None else jnp.asarray(weight))
-        return self._fit_fn(self.param.num_boost_round)(
-            jnp.asarray(bins), jnp.asarray(label, jnp.float32), weight)
+        bins = jnp.asarray(bins)
+        return self._fit_fn(self.param.num_boost_round, self._method(bins))(
+            bins, jnp.asarray(label, jnp.float32), weight)
 
     def boost_round(self, margin, bins, label, weight):
         """One boosting round (the unit train step for streaming/bench)."""
-        return self._round_fn()(margin, bins, label, weight)
+        return self._round_fn(self._method(bins, margin))(
+            margin, bins, label, weight)
 
     def predict_margin(self, ensemble: TreeEnsemble, bins):
         return self._predict_fn()(ensemble, bins)

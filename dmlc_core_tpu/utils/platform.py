@@ -1,0 +1,31 @@
+"""Make ``JAX_PLATFORMS`` authoritative even under plugin-pinning images.
+
+Some TPU images register their PJRT plugin from ``sitecustomize`` and pin
+``jax_platforms`` via ``jax.config`` at import time, which silently overrides
+the ``JAX_PLATFORMS`` environment variable.  CLIs that must honor an explicit
+platform request (tests on a virtual CPU mesh, examples run off-accelerator)
+call :func:`sync_platform_from_env` right after importing jax.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["sync_platform_from_env"]
+
+
+def sync_platform_from_env() -> None:
+    """Re-assert ``JAX_PLATFORMS`` from the environment onto jax.config.
+
+    No-op when the variable is unset or jax already agrees.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass

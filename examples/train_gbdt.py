@@ -25,10 +25,18 @@ def main():
     ap.add_argument("--max-depth", type=int, default=6)
     ap.add_argument("--num-bins", type=int, default=256)
     ap.add_argument("--learning-rate", type=float, default=0.3)
+    ap.add_argument("--hist-method", default="auto",
+                    choices=["auto", "onehot", "scatter"],
+                    help="histogram algorithm (auto: MXU matmul on TPU, "
+                         "scatter on CPU)")
     ap.add_argument("--checkpoint", default="")
     args = ap.parse_args()
 
     import jax
+
+    from dmlc_core_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
 
     from dmlc_core_tpu.bridge.batching import dense_batches
     from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
@@ -53,7 +61,8 @@ def main():
     print(meter.summary())
 
     param = GBDTParam(num_boost_round=args.rounds, max_depth=args.max_depth,
-                      num_bins=args.num_bins, learning_rate=args.learning_rate)
+                      num_bins=args.num_bins, learning_rate=args.learning_rate,
+                      hist_method=args.hist_method)
     model = GBDT(param, num_feature=args.num_feature)
     model.make_bins(x[: min(len(x), 100_000)])
     bins = np.asarray(model.bin_features(x)).astype(np.int32)
