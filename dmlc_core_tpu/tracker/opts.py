@@ -51,6 +51,8 @@ def get_opts(args=None) -> argparse.Namespace:
                         help="tracker bind IP (default: auto-detect)")
     parser.add_argument("--env", action="append", default=[],
                         help="extra KEY=VALUE env to forward (repeatable)")
+    parser.add_argument("--mesos-master", default=None,
+                        help="(mesos) master host[:port]; default $MESOS_MASTER")
     parser.add_argument("--num-attempt", type=int,
                         default=int(os.environ.get("DMLC_NUM_ATTEMPT", "1")),
                         help="per-worker retry attempts (local backend)")

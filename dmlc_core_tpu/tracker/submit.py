@@ -93,9 +93,10 @@ def main(argv=None) -> int:
         from dmlc_core_tpu.tracker import tpu_vm as backend
     elif opts.cluster == "yarn":
         from dmlc_core_tpu.tracker import yarn as backend
+    elif opts.cluster == "mesos":
+        from dmlc_core_tpu.tracker import mesos as backend
     else:
-        print(f"error: cluster backend {opts.cluster!r} is not available in "
-              f"this build (mesos is EOL upstream; see PARITY.md)",
+        print(f"error: unknown cluster backend {opts.cluster!r}",
               file=sys.stderr)
         return 2
     backend.submit(opts)

@@ -1,0 +1,89 @@
+"""Mesos backend test with the task runner stubbed out.
+
+Reference behavior under test (tracker/dmlc_tracker/mesos.py): one task per
+worker/server with cpus/mem resources, DMLC_ROLE + per-role id env, env
+whitelist forwarding, mesos-execute command construction, MESOS_MASTER
+requirement with default port 5050.
+"""
+
+import json
+
+import pytest
+
+from dmlc_core_tpu.tracker import mesos
+from dmlc_core_tpu.tracker.opts import get_opts
+
+
+def test_mesos_requires_master(monkeypatch):
+    monkeypatch.delenv("MESOS_MASTER", raising=False)
+    opts = get_opts(["--cluster", "mesos", "--num-workers", "1", "--",
+                     "true"])
+    with pytest.raises(RuntimeError, match="MESOS_MASTER"):
+        mesos.submit(opts)
+
+
+def test_mesos_master_default_port(monkeypatch):
+    monkeypatch.setenv("MESOS_MASTER", "m1")
+    opts = get_opts(["--cluster", "mesos", "--num-workers", "1", "--",
+                     "true"])
+    assert mesos._resolve_master(opts) == "m1:5050"
+
+
+def test_mesos_explicit_env_wins_over_forwarded(monkeypatch):
+    launched = []
+
+    def fake_run(master, prog, env, resources):
+        launched.append(env)
+
+    monkeypatch.setattr(mesos, "_run_task", fake_run)
+    monkeypatch.setenv("LD_LIBRARY_PATH", "/shell/lib")
+    opts = get_opts(["--cluster", "mesos", "--num-workers", "1",
+                     "--mesos-master", "m", "--env",
+                     "LD_LIBRARY_PATH=/custom/lib", "--", "true"])
+    mesos.submit(opts)
+    assert launched[0]["LD_LIBRARY_PATH"] == "/custom/lib"
+
+
+def test_mesos_submit_tasks(monkeypatch):
+    launched = []
+
+    def fake_run(master, prog, env, resources):
+        launched.append((master, prog, env, resources))
+
+    monkeypatch.setattr(mesos, "_run_task", fake_run)
+    monkeypatch.setenv("OMP_NUM_THREADS", "3")
+
+    opts = get_opts(["--cluster", "mesos", "--num-workers", "2",
+                     "--num-servers", "1", "--mesos-master", "master-host",
+                     "--worker-cores", "2", "--worker-memory", "2g",
+                     "--server-cores", "1", "--server-memory", "512m",
+                     "--", "python", "train.py"])
+    mesos.submit(opts)  # fun_submit joins its task threads before returning
+    assert len(launched) == 3
+
+    roles = sorted(env["DMLC_ROLE"] for _, _, env, _ in launched)
+    assert roles == ["server", "worker", "worker"]
+    task_ids = sorted(env["DMLC_TASK_ID"] for _, _, env, _ in launched)
+    assert task_ids == ["0", "1", "2"]
+    for master, prog, env, resources in launched:
+        assert master == "master-host:5050"
+        assert prog == "python train.py"
+        assert env["OMP_NUM_THREADS"] == "3"
+        assert "DMLC_TRACKER_URI" in env
+        if env["DMLC_ROLE"] == "server":
+            assert env["DMLC_SERVER_ID"] == "0"
+            assert resources == {"cpus": 1.0, "mem": 512.0}
+        else:
+            assert env["DMLC_WORKER_ID"] in ("0", "1")
+            assert resources == {"cpus": 2.0, "mem": 2048.0}
+
+
+def test_mesos_execute_argv():
+    argv = mesos._mesos_execute_argv(
+        "m1:5050", "python train.py", {"A": "1"}, {"cpus": 2.0, "mem": 64.0})
+    assert argv[0] == "mesos-execute"
+    assert argv[1] == "--master=m1:5050"
+    assert argv[3].startswith("--command=cd ")
+    assert argv[3].endswith("&& python train.py")
+    assert json.loads(argv[4][len("--env="):]) == {"A": "1"}
+    assert argv[5] == "--resources=cpus:2.0;mem:64.0"
