@@ -24,6 +24,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from dmlc_core_tpu import native_bridge
 from dmlc_core_tpu.io.stream import Stream
 from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ
 
@@ -93,6 +94,28 @@ class RecordIOWriter:
             out.append(b"\x00" * pad)
         self._stream.write(b"".join(out))
 
+    def write_records(self, records: List[bytes]) -> List[int]:
+        """Batch write; returns the stream offset of each record.  Uses the
+        native batch framer (native/recordio.cc) when available."""
+        base = self.tell()
+        if native_bridge.available():
+            lens = np.fromiter((len(r) for r in records), dtype=np.int64,
+                               count=len(records))
+            CHECK(bool((lens < (1 << 29)).all()),
+                  "RecordIO only accepts records below 2^29 bytes")
+            framed, offsets, nexc = native_bridge.recordio_frame(
+                b"".join(records), lens)
+            self._stream.write(framed)
+            self.except_counter += nexc
+            return [base + int(o) for o in offsets]
+        out = []
+        for rec in records:
+            out.append(self.tell())
+            # unbound base call: subclasses (IndexedRecordIOWriter) track
+            # offsets in their write_records override, not per record here
+            RecordIOWriter.write_record(self, rec)
+        return out
+
     def tell(self) -> int:
         return self._stream.tell()
 
@@ -112,6 +135,12 @@ class IndexedRecordIOWriter(RecordIOWriter):
         self.offsets.append(self.tell())
         super().write_record(data)
         self._next_id += 1
+
+    def write_records(self, records: List[bytes]) -> List[int]:
+        offs = super().write_records(records)
+        self.offsets.extend(offs)
+        self._next_id += len(records)
+        return offs
 
     def save_index(self, index_stream: Stream) -> None:
         text = "".join(f"{i} {off}\n" for i, off in enumerate(self.offsets))
@@ -180,11 +209,40 @@ class RecordIOChunkReader:
         nstep = ((nstep + 3) >> 2) << 2
         begin = min(size, nstep * part_index)
         end = min(size, nstep * (part_index + 1))
+        # native fast path: single C++ pass over the partition up front
+        # (native/recordio.cc), then per-record emission is array walking.
+        self._scan = None
+        self._scan_i = 0
+        if native_bridge.available():
+            head, plen, escaped, pbegin, pend = native_bridge.recordio_scan(
+                self._chunk, begin, end)
+            self._scan = (head, plen, escaped)
+            self._pbegin, self._pend = pbegin, pend
+            return
         self._pbegin = find_next_record_head(self._chunk, begin, size)
         self._pend = find_next_record_head(self._chunk, end, size)
 
+    def _next_record_scanned(self) -> Optional[memoryview]:
+        head, plen, escaped = self._scan
+        i = self._scan_i
+        if i >= len(head):
+            return None
+        self._scan_i = i + 1
+        start = int(head[i])
+        length = int(plen[i])
+        view = memoryview(self._chunk)
+        if not escaped[i]:
+            return view[start + 8:start + 8 + length]
+        # rare: reassemble the escaped parts natively (restores the in-band
+        # magic cells; the scan already validated the part structure)
+        out = native_bridge.recordio_extract(self._chunk, start, length)
+        CHECK_EQ(len(out), length, "invalid RecordIO format")
+        return memoryview(out)
+
     def next_record(self) -> Optional[memoryview]:
         """Next record (zero-copy memoryview for unescaped records), or None."""
+        if self._scan is not None:
+            return self._next_record_scanned()
         if self._pbegin >= self._pend:
             return None
         view = memoryview(self._chunk)

@@ -70,6 +70,30 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dmlc_tpu_find_magic.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32,
             ctypes.c_void_p, ctypes.c_int64]
+        lib.dmlc_tpu_recordio_scan.restype = ctypes.c_void_p
+        lib.dmlc_tpu_recordio_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+        lib.dmlc_tpu_recordio_scan_dims.argtypes = [
+            ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_int64)] * 3
+        lib.dmlc_tpu_recordio_scan_error.restype = ctypes.c_char_p
+        lib.dmlc_tpu_recordio_scan_error.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_recordio_scan_fill.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_void_p] * 3
+        lib.dmlc_tpu_recordio_scan_free.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_recordio_extract.restype = ctypes.c_int64
+        lib.dmlc_tpu_recordio_extract.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64]
+        lib.dmlc_tpu_recordio_frame.restype = ctypes.c_void_p
+        lib.dmlc_tpu_recordio_frame.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.dmlc_tpu_frame_dims.argtypes = [
+            ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_int64)] * 3
+        lib.dmlc_tpu_frame_error.restype = ctypes.c_char_p
+        lib.dmlc_tpu_frame_error.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_frame_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.dmlc_tpu_frame_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -157,3 +181,75 @@ def find_magic_positions(data: bytes, magic: int, limit: int) -> np.ndarray:
     out = np.empty(limit, dtype=np.int64)
     n = lib.dmlc_tpu_find_magic(data, len(data), magic, _ptr(out), limit)
     return out[:min(n, limit)]
+
+
+def recordio_scan(data: bytes, begin: int, end: int
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int, int]:
+    """One-pass record scan of a chunk partition.
+
+    Returns ``(head, plen, escaped, pbegin, pend)``: per-record head byte
+    offsets, logical payload lengths, escaped flags, and the resynced
+    partition bounds (reference RecordIOChunkReader, src/recordio.cc:102-156).
+    """
+    lib = _load()
+    assert lib is not None
+    handle = lib.dmlc_tpu_recordio_scan(data, len(data), begin, end)
+    try:
+        n = ctypes.c_int64()
+        pbegin = ctypes.c_int64()
+        pend = ctypes.c_int64()
+        lib.dmlc_tpu_recordio_scan_dims(handle, ctypes.byref(n),
+                                        ctypes.byref(pbegin),
+                                        ctypes.byref(pend))
+        if n.value < 0:
+            raise ValueError(lib.dmlc_tpu_recordio_scan_error(handle).decode())
+        head = np.empty(n.value, dtype=np.int64)
+        plen = np.empty(n.value, dtype=np.int64)
+        escaped = np.empty(n.value, dtype=np.uint8)
+        lib.dmlc_tpu_recordio_scan_fill(handle, _ptr(head), _ptr(plen),
+                                        _ptr(escaped))
+        return head, plen, escaped, pbegin.value, pend.value
+    finally:
+        lib.dmlc_tpu_recordio_scan_free(handle)
+
+
+def recordio_extract(data: bytes, head: int, length: int) -> bytes:
+    """Reassemble one (escaped) record whose head is at byte offset ``head``;
+    ``length`` is its logical payload length from a prior scan."""
+    lib = _load()
+    assert lib is not None
+    out = np.empty(length, dtype=np.uint8)
+    got = lib.dmlc_tpu_recordio_extract(data, len(data), head, _ptr(out),
+                                        length)
+    if got < 0:
+        raise ValueError("invalid RecordIO format: bad record head")
+    return out[:got].tobytes()
+
+
+def recordio_frame(payloads: bytes, lens: np.ndarray
+                   ) -> Tuple[memoryview, np.ndarray, int]:
+    """Batch-encode concatenated payloads into RecordIO framing.
+
+    Returns ``(framed, offsets, except_count)`` where ``framed`` is a
+    memoryview over a freshly-filled buffer (no extra copy) and
+    ``offsets[i]`` is the start of record i within it (reference writer,
+    recordio.cc:11-51).
+    """
+    lib = _load()
+    assert lib is not None
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    handle = lib.dmlc_tpu_recordio_frame(payloads, _ptr(lens), len(lens))
+    try:
+        size = ctypes.c_int64()
+        n_off = ctypes.c_int64()
+        nexc = ctypes.c_int64()
+        lib.dmlc_tpu_frame_dims(handle, ctypes.byref(size),
+                                ctypes.byref(n_off), ctypes.byref(nexc))
+        if size.value < 0:
+            raise ValueError(lib.dmlc_tpu_frame_error(handle).decode())
+        out = np.empty(size.value, dtype=np.uint8)
+        offsets = np.empty(n_off.value, dtype=np.int64)
+        lib.dmlc_tpu_frame_fill(handle, _ptr(out), _ptr(offsets))
+        return memoryview(out).cast("B"), offsets, nexc.value
+    finally:
+        lib.dmlc_tpu_frame_free(handle)

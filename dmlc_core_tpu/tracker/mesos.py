@@ -44,7 +44,7 @@ def _resolve_master(opts) -> str:
     return master
 
 
-def _try_pymesos_run(prog: str, env: Dict[str, str],
+def _try_pymesos_run(master: str, prog: str, env: Dict[str, str],
                      resources: Dict[str, float]) -> bool:
     """Run through pymesos when available; returns False to fall back."""
     try:
@@ -52,6 +52,9 @@ def _try_pymesos_run(prog: str, env: Dict[str, str],
     except ImportError:
         return False
     logging.getLogger("pymesos").setLevel(logging.WARNING)
+    # pymesos reads the master from the env; hand it the resolved address so
+    # --mesos-master and the :5050 default take effect on this path too
+    os.environ["MESOS_MASTER"] = master
     pymesos.subprocess.check_call(
         prog, shell=True, env=env, cwd=os.getcwd(),
         cpus=resources["cpus"], mem=resources["mem"])
@@ -74,7 +77,7 @@ def _mesos_execute_argv(master: str, prog: str, env: Dict[str, str],
 
 def _run_task(master: str, prog: str, env: Dict[str, str],
               resources: Dict[str, float]) -> None:
-    if _try_pymesos_run(prog, env, resources):
+    if _try_pymesos_run(master, prog, env, resources):
         return
     argv = _mesos_execute_argv(master, prog, env, resources)
     proc = subprocess.run(argv, stdout=subprocess.PIPE,
