@@ -41,3 +41,5 @@ __version__ = "0.1.0"
 from dmlc_core_tpu.utils.logging import Error, CHECK, CHECK_EQ, LOG  # noqa: F401
 from dmlc_core_tpu.param import Parameter, ParamError, field, get_env  # noqa: F401
 from dmlc_core_tpu.registry import Registry  # noqa: F401
+from dmlc_core_tpu.json_io import (  # noqa: F401
+    JSONReader, JSONWriter, JSONObjectReadHelper, JSONError, register_any_type)
