@@ -9,9 +9,10 @@ dense batch -> device binning -> jit'd boosting rounds); the timed region is
 training, matching how XGBoost reports hist rows/sec.
 
 vs_baseline = TPU rows/sec / single-host-CPU rows/sec on the same training
-workload, each device running its best hist formulation (one-hot MXU matmul
-on TPU, segment-sum scatter on CPU — same splits/accuracy, different
-algorithm mapping).  The north-star target is >=5x single-host.
+workload, each device running its best hist formulation (VMEM-resident
+pallas hist kernel on TPU, segment-sum scatter on CPU — same
+splits/accuracy, different algorithm mapping).  The north-star target is
+>=5x single-host.
 
 Prints ONE JSON line.
 """
@@ -97,12 +98,14 @@ def main():
     with jax.default_device(accel):
         bins = np.asarray(apply_bins(x, model.boundaries)).astype(np.int32)
 
-    accel_method = "scatter" if accel.platform == "cpu" else "onehot"
+    from dmlc_core_tpu.ops.histogram import resolve_hist_method
+
+    accel_method = resolve_hist_method("auto")
     tpu_rps, tpu_s, acc = time_fit(model, bins, y, TPU_ROUNDS, accel,
                                    accel_method)
 
     # single-host CPU baseline on the identical workload (scatter is the
-    # fastest CPU hist formulation; onehot is the fastest TPU one)
+    # fastest CPU hist formulation; the pallas kernel is the fastest TPU one)
     cpu = jax.devices("cpu")[0]
     cpu_rps, cpu_s, _ = time_fit(model, bins, y, CPU_ROUNDS, cpu, "scatter")
 

@@ -51,9 +51,10 @@ class GBDTParam(Parameter):
     objective = field(str, default="logistic", enum=["logistic", "squared"],
                       help="loss")
     hist_method = field(str, default="auto",
-                        enum=["auto", "onehot", "scatter"],
-                        help="histogram algorithm: one-hot MXU matmul (TPU) "
-                             "or segment-sum scatter (CPU)")
+                        enum=["auto", "pallas", "onehot", "scatter"],
+                        help="histogram algorithm: VMEM-resident pallas "
+                             "kernel (TPU), one-hot MXU matmul, or "
+                             "segment-sum scatter (CPU)")
 
 
 class TreeEnsemble(NamedTuple):
@@ -129,7 +130,7 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     import jax
 
     n_leaf = 2 ** max_depth
-    if method == "onehot":
+    if method in ("onehot", "pallas"):
         # leaf sums as a (tiny) f32 matmul — TPU scatter-adds serialise
         leafhot = (node[:, None] == jnp.arange(n_leaf, dtype=node.dtype)
                    ).astype(jnp.float32)                 # [B, n_leaf]
@@ -185,7 +186,19 @@ class GBDT:
 
     # -- compiled round/predict ----------------------------------------------
     def _method(self, *arrays) -> str:
-        return resolve_hist_method(self.param.hist_method, *arrays)
+        method = resolve_hist_method(self.param.hist_method, *arrays)
+        if method == "pallas":
+            from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem
+
+            # the kernel keeps the deepest level's [2n, F*nbins] f32
+            # accumulator resident in VMEM; decide up front so the onehot
+            # fallback still amortises its matmul RHS across rounds
+            deepest = 2 ** (self.param.max_depth - 1)
+            if (self.model_axis is not None
+                    or not hist_fits_vmem(deepest, self.num_feature,
+                                          self.param.num_bins)):
+                method = "onehot"
+        return method
 
     @functools.lru_cache(maxsize=None)
     def _round_fn(self, method: str = "scatter"):
@@ -217,6 +230,18 @@ class GBDT:
         def fit(bins, label, weight):
             import jax.numpy as jnp
 
+            n_rows = bins.shape[0]
+            if method == "pallas":
+                from dmlc_core_tpu.ops.hist_pallas import BLOCK_ROWS
+
+                # pad rows to the kernel's tile multiple ONCE per fit (padded
+                # rows carry weight 0, so they vanish from every histogram);
+                # per-call padding inside the kernel wrapper then no-ops
+                pad = -n_rows % BLOCK_ROWS
+                if pad:
+                    bins = jnp.pad(bins, ((0, pad), (0, 0)))
+                    label = jnp.pad(label, (0, pad))
+                    weight = jnp.pad(weight, (0, pad))
             B = bins.shape[0]
             # the bin one-hot (the matmul RHS) is invariant across rounds and
             # levels: materialise once, outside the scan
@@ -236,7 +261,7 @@ class GBDT:
             margin0 = jnp.zeros((B,), dtype=jnp.float32)
             margin, (sfs, sbs, lvs) = lax.scan(body, margin0, None,
                                                length=num_rounds)
-            return TreeEnsemble(sfs, sbs, lvs), margin
+            return TreeEnsemble(sfs, sbs, lvs), margin[:n_rows]
 
         return jax.jit(fit)
 

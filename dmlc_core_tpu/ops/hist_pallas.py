@@ -1,0 +1,160 @@
+"""Pallas TPU kernel: gradient histograms without materialising the one-hot.
+
+The ``"onehot"`` method in :mod:`.histogram` casts the XGBoost-hist kernel
+(reference workload: src/data + Rabit hist aggregation consumers) as an MXU
+matmul ``W[M, B] @ onehot[B, F*nbins]``.  That is compute-shaped right, but
+HBM-bound: the materialised one-hot is ``F*nbins/8`` times larger than the
+binned features (28 feat x 256 bins -> 14 KB/row in bf16 vs 112 B/row of
+int32 bins), and every tree level of every boosting round re-reads all of it.
+
+This kernel keeps the matmul but builds the one-hot **tile-by-tile in VMEM**:
+
+- grid = row tiles (1-D, sequential on TPU);
+- the ``[M, F*nbins]`` f32 accumulator lives in one VMEM output block whose
+  index map is constant, so it persists across grid steps (zeroed at step 0);
+- per step: DMA ``W`` tile ``[M, TB]`` (bf16) + bins tile ``[TB, F]``
+  (int32), then for each feature compare-to-iota -> ``[TB, nbins]`` one-hot
+  in VMEM and issue one MXU dot, accumulating in f32.
+
+HBM traffic per level falls from ``B*F*nbins*2`` bytes to
+``B*(4F + 2M + 12)`` — ~100x for the flagship shapes — turning the histogram
+from bandwidth- to compute-bound.  Numerics match the ``"onehot"`` method
+exactly (same bf16 one-hot / bf16 W / f32 accumulate).
+
+Used automatically on TPU via ``resolve_hist_method("auto")`` when the
+histogram block fits VMEM; falls back to the plain one-hot matmul otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["hist_matmul_pallas", "grad_hist_pallas", "pallas_supported"]
+
+# flipped by tests to run the kernel in interpreter mode on CPU
+_INTERPRET = False
+
+# row-tile size: callers that want the wrapper's internal padding to no-op
+# (e.g. GBDT's fit-level padding) must pad to a multiple of this
+BLOCK_ROWS = 1024
+
+# VMEM budget for the resident accumulator block (bytes); above this
+# callers fall back to the plain one-hot matmul.
+_ACC_BYTES_LIMIT = 8 * 1024 * 1024
+
+
+def _pad_nodes(num_nodes: int) -> int:
+    """Node-slot padding so M = 2*n_pad is a multiple of the bf16 tile (16)."""
+    return -(-max(8, num_nodes) // 8) * 8
+
+
+def hist_fits_vmem(num_nodes: int, num_feature: int, num_bins: int) -> bool:
+    """Whether the resident [2*n_pad, F*nbins] f32 accumulator fits VMEM."""
+    return 2 * _pad_nodes(num_nodes) * num_feature * num_bins * 4 \
+        <= _ACC_BYTES_LIMIT
+
+
+def _kernel(w_ref, bins_ref, out_ref, *, num_feature: int, num_bins: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    w = w_ref[:]                                   # [M, TB] bf16
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
+    for f in range(num_feature):
+        onehot = (bins_ref[:, f:f + 1] == iota).astype(w.dtype)  # [TB, nbins]
+        out_ref[:, f * num_bins:(f + 1) * num_bins] += jax.lax.dot_general(
+            w, onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def hist_matmul_pallas(w, bins, num_bins: int, block_rows: int = BLOCK_ROWS):
+    """``out[m, f*nbins + b] = sum_i w[m, i] * (bins[i, f] == b)``.
+
+    Args:
+      w: [M, B] bf16 per-row weights (M multiple of 16; rows beyond the live
+        node count must be zero).
+      bins: [B, F] int32 binned features in [0, num_bins).
+      num_bins: static bin count.
+      block_rows: row-tile size (B is padded up to a multiple internally).
+
+    Returns [M, F*num_bins] float32.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, b = w.shape
+    bf = bins.shape[1]
+    if b % block_rows:
+        pad = block_rows - b % block_rows
+        w = jnp.pad(w, ((0, 0), (0, pad)))         # zero W => zero contribution
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        b += pad
+    kernel = functools.partial(_kernel, num_feature=bf, num_bins=num_bins)
+    return pl.pallas_call(
+        kernel,
+        grid=(b // block_rows,),
+        in_specs=[
+            pl.BlockSpec((m, block_rows), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, bf), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((m, bf * num_bins), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, bf * num_bins), jnp.float32),
+        interpret=_INTERPRET,
+    )(w, bins)
+
+
+def grad_hist_pallas(bins, node_ids, grad, hess, num_nodes: int,
+                     num_bins: int):
+    """Per-(node, feature, bin) gradient/hessian sums via the VMEM kernel.
+
+    Same contract as :func:`.histogram.grad_histogram`; returns (G, H) each
+    [num_nodes, F, num_bins] float32.  Rows with out-of-range (e.g. negative)
+    node ids contribute nothing.
+    """
+    import jax.numpy as jnp
+
+    bins = jnp.asarray(bins).astype(jnp.int32)
+    bf = bins.shape[1]
+    n_pad = _pad_nodes(num_nodes)
+    iota_n = jnp.arange(n_pad, dtype=jnp.int32)
+    nodehot = node_ids.astype(jnp.int32)[None, :] == iota_n[:, None]  # [n, B]
+    w = jnp.concatenate([
+        jnp.where(nodehot, grad[None, :], 0.0),
+        jnp.where(nodehot, hess[None, :], 0.0),
+    ], axis=0).astype(jnp.bfloat16)                # [2*n_pad, B]
+    out = hist_matmul_pallas(w, bins, num_bins)
+    out = out.reshape(2, n_pad, bf, num_bins)
+    return out[0, :num_nodes], out[1, :num_nodes]
+
+
+@functools.lru_cache(maxsize=None)
+def pallas_supported() -> bool:
+    """Probe once whether the Pallas TPU path compiles+runs on this backend."""
+    import jax
+
+    if jax.default_backend() == "cpu" and not _INTERPRET:
+        return False
+    try:
+        import jax.numpy as jnp
+
+        w = jnp.zeros((16, 128), jnp.bfloat16).at[0, 0].set(1.0)
+        bins = jnp.zeros((128, 2), jnp.int32)
+        out = jax.jit(lambda w, b: hist_matmul_pallas(w, b, 8,
+                                                      block_rows=128))(w, bins)
+        return bool(np.asarray(out)[0, 0] == 1.0)
+    except Exception:
+        return False

@@ -42,8 +42,9 @@ def resolve_hist_method(method: str, *arrays) -> str:
     """Resolve ``"auto"`` to a concrete histogram algorithm.
 
     Prefers the committed platform of any input jax.Array, falling back to
-    ``jax.default_backend()``: MXU one-hot matmuls on TPU/GPU, scatter
-    segment-sums on CPU.
+    ``jax.default_backend()``: on TPU/GPU the VMEM-resident Pallas kernel
+    when available (else the plain one-hot MXU matmul), scatter segment-sums
+    on CPU.
     """
     if method != "auto":
         return method
@@ -60,7 +61,11 @@ def resolve_hist_method(method: str, *arrays) -> str:
                 continue
     if platform is None:
         platform = jax.default_backend()
-    return "scatter" if platform == "cpu" else "onehot"
+    if platform == "cpu":
+        return "scatter"
+    from dmlc_core_tpu.ops.hist_pallas import pallas_supported
+
+    return "pallas" if pallas_supported() else "onehot"
 
 
 def bin_onehot(bins, num_bins: int, dtype=None):
@@ -145,8 +150,23 @@ def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,
     bins = jnp.asarray(bins)
     B, F = bins.shape
     method = resolve_hist_method(method, bins, grad)
+    if method == "pallas":
+        from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem
 
-    if method == "onehot":
+        if model_axis is not None or not hist_fits_vmem(num_nodes, F,
+                                                        num_bins):
+            # pallas_call is not GSPMD-partitionable, and the kernel keeps
+            # the whole [2n, F*nbins] accumulator resident in VMEM; in
+            # either case the plain matmul (XLA-shardable, HBM-tiled) is
+            # the right fallback.
+            method = "onehot"
+
+    if method == "pallas":
+        from dmlc_core_tpu.ops.hist_pallas import grad_hist_pallas
+
+        G, H = grad_hist_pallas(bins, node_ids, grad, hess, num_nodes,
+                                num_bins)
+    elif method == "onehot":
         if onehot is None:
             onehot = bin_onehot(bins, num_bins)
         dt = onehot.dtype

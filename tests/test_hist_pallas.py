@@ -1,0 +1,122 @@
+"""Pallas histogram kernel vs the exact scatter formulation (interpret mode).
+
+The kernel's numerics are bf16-one-hot x bf16-W with f32 accumulation — the
+same contract as the plain one-hot matmul — so tolerances below reflect bf16
+rounding of g/h, not algorithmic drift.
+"""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.ops import hist_pallas
+from dmlc_core_tpu.ops.histogram import grad_histogram
+
+
+@pytest.fixture(autouse=True)
+def interpret_mode():
+    hist_pallas._INTERPRET = True
+    hist_pallas.pallas_supported.cache_clear()
+    yield
+    hist_pallas._INTERPRET = False
+    hist_pallas.pallas_supported.cache_clear()
+
+
+def _rand_case(b, f, nbins, nnodes, seed=0):
+    rng = np.random.RandomState(seed)
+    bins = rng.randint(0, nbins, (b, f)).astype(np.int32)
+    node = rng.randint(0, nnodes, b).astype(np.int32)
+    g = rng.randn(b).astype(np.float32)
+    h = rng.rand(b).astype(np.float32)
+    return bins, node, g, h
+
+
+@pytest.mark.parametrize("b,f,nbins,nnodes", [
+    (256, 3, 8, 4),      # one tile exactly (block_rows padding no-op path)
+    (300, 5, 16, 2),     # row padding inside the wrapper
+    (700, 2, 4, 8),      # multi-tile accumulation across grid steps
+])
+def test_matches_scatter(b, f, nbins, nnodes):
+    bins, node, g, h = _rand_case(b, f, nbins, nnodes)
+    G, H = hist_pallas.grad_hist_pallas(bins, node, g, h, nnodes, nbins)
+    Gr, Hr = grad_histogram(bins, node, g, h, nnodes, nbins, method="scatter")
+    assert G.shape == (nnodes, f, nbins)
+    np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(H), np.asarray(Hr),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_negative_node_ids_drop_out():
+    bins, node, g, h = _rand_case(128, 2, 4, 2, seed=1)
+    node[:50] = -1
+    G, H = hist_pallas.grad_hist_pallas(bins, node, g, h, 2, 4)
+    mask = node >= 0
+    Gr, Hr = grad_histogram(bins[mask], node[mask], g[mask], h[mask], 2, 4,
+                            method="scatter")
+    np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(H), np.asarray(Hr),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_grad_histogram_dispatches_pallas():
+    bins, node, g, h = _rand_case(256, 3, 8, 4, seed=2)
+    G, H = grad_histogram(bins, node, g, h, 4, 8, method="pallas")
+    Gr, Hr = grad_histogram(bins, node, g, h, 4, 8, method="scatter")
+    np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_vmem_overflow_falls_back_to_onehot():
+    """auto on deep trees must not pick pallas (accumulator exceeds VMEM)."""
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+    from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem
+
+    assert hist_fits_vmem(32, 28, 256)
+    assert not hist_fits_vmem(512, 28, 256)       # depth-10 deepest level
+    model = GBDT(GBDTParam(max_depth=10, num_bins=256, hist_method="pallas"),
+                 num_feature=28)
+    assert model._method() == "onehot"
+    shallow = GBDT(GBDTParam(max_depth=6, num_bins=256,
+                             hist_method="pallas"), num_feature=28)
+    assert shallow._method() == "pallas"
+    sharded = GBDT(GBDTParam(max_depth=6, num_bins=256,
+                             hist_method="pallas"), num_feature=28,
+                   model_axis="model")
+    assert sharded._method() == "onehot"
+
+
+def test_non_power_of_two_nodes_padding():
+    """M = 2*n_pad must stay a multiple of the bf16 tile for any node count."""
+    bins, node, g, h = _rand_case(256, 2, 8, 12, seed=4)
+    G, H = hist_pallas.grad_hist_pallas(bins, node, g, h, 12, 8)
+    Gr, _ = grad_histogram(bins, node, g, h, 12, 8, method="scatter")
+    assert G.shape == (12, 2, 8)
+    np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_gbdt_fit_pallas_matches_scatter_splits():
+    """End-to-end tiny fit: pallas and scatter grow the same trees."""
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(300, 4).astype(np.float32)   # row count forces fit padding
+    y = (x[:, 0] + 0.1 * rng.randn(300) > 0).astype(np.float32)
+    param = GBDTParam(num_boost_round=2, max_depth=3, num_bins=16,
+                      hist_method="pallas")
+    model = GBDT(param, num_feature=4)
+    model.make_bins(x)
+    bins = np.asarray(model.bin_features(x))
+    ens_p, margin_p = model.fit_binned(bins, y)
+
+    model_s = GBDT(GBDTParam(num_boost_round=2, max_depth=3, num_bins=16,
+                             hist_method="scatter"), num_feature=4)
+    model_s.boundaries = model.boundaries
+    ens_s, margin_s = model_s.fit_binned(bins, y)
+
+    assert margin_p.shape == (300,)
+    np.testing.assert_array_equal(np.asarray(ens_p.split_feat),
+                                  np.asarray(ens_s.split_feat))
+    np.testing.assert_allclose(np.asarray(margin_p), np.asarray(margin_s),
+                               rtol=5e-2, atol=5e-2)
