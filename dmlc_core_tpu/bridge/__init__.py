@@ -15,4 +15,7 @@ from dmlc_core_tpu.bridge.batching import (  # noqa: F401
     block_to_sparse,
 )
 from dmlc_core_tpu.bridge.loader import MeshBatchLoader  # noqa: F401
-from dmlc_core_tpu.bridge.checkpoint import save_checkpoint, load_checkpoint  # noqa: F401
+from dmlc_core_tpu.bridge.checkpoint import (save_checkpoint,  # noqa: F401
+                                             load_checkpoint,
+                                             AsyncCheckpointer,
+                                             CheckpointManager)

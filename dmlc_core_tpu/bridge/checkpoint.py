@@ -14,14 +14,18 @@ Header: {"leaves": [{"path": str, "dtype": str, "shape": [...]}, ...]}.
 from __future__ import annotations
 
 import json
-from typing import Any
+import os
+import re
+import threading
+from typing import Any, List, Optional
 
 import numpy as np
 
 from dmlc_core_tpu.io.stream import create_stream, create_stream_for_read
-from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ
+from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ, log_info, log_warning
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "AsyncCheckpointer",
+           "CheckpointManager"]
 
 _MAGIC = b"DMLCTPU1"
 
@@ -35,10 +39,21 @@ def _flatten(tree: Any):
     return paths, values, treedef
 
 
-def save_checkpoint(uri: str, tree: Any) -> None:
-    """Write a pytree of arrays/scalars to ``uri``."""
-    import jax
+def _is_local_uri(uri: str) -> bool:
+    return "://" not in uri or uri.startswith("file://")
 
+
+def _strip_file_scheme(uri: str) -> str:
+    return uri.replace("file://", "", 1)
+
+
+def save_checkpoint(uri: str, tree: Any) -> None:
+    """Write a pytree of arrays/scalars to ``uri``.
+
+    Local writes are atomic (temp file + rename), so a crash mid-write never
+    leaves a truncated checkpoint at the final path.  Remote stores already
+    commit object writes atomically at close (e.g. S3 complete-multipart).
+    """
     paths, values, _ = _flatten(tree)
     arrays = [np.asarray(v) for v in values]
     header = json.dumps({
@@ -47,12 +62,18 @@ def save_checkpoint(uri: str, tree: Any) -> None:
             for p, a in zip(paths, arrays)
         ]
     }).encode("utf-8")
-    with create_stream(uri, "w") as fo:
+    target = uri
+    local = _is_local_uri(uri)
+    if local:
+        target = uri + ".tmp"
+    with create_stream(target, "w") as fo:
         fo.write(_MAGIC)
         fo.write_u64(len(header))
         fo.write(header)
         for a in arrays:
             fo.write(np.ascontiguousarray(a).tobytes())
+    if local:
+        os.replace(_strip_file_scheme(target), _strip_file_scheme(uri))
 
 
 def load_checkpoint(uri: str, template: Any = None) -> Any:
@@ -86,3 +107,162 @@ def load_checkpoint(uri: str, template: Any = None) -> Any:
                  f"shape mismatch for leaf {p!r}")
         new_values.append(arr)
     return jax.tree_util.tree_unflatten(treedef, new_values)
+
+
+class AsyncCheckpointer:
+    """Orbax-style async checkpoint writes (SURVEY.md §5.4).
+
+    ``save`` synchronously snapshots device arrays to host memory (so the
+    training step can immediately mutate state) and hands the byte writing —
+    typically the slow part on a remote store — to a background thread.  At
+    most one write is in flight; a second ``save`` first waits for the
+    previous one.  Errors from the background write surface on the next
+    ``save``/``wait_until_finished`` call, carrying the failed URI.
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._error_uri: Optional[str] = None
+
+    def save(self, uri: str, tree: Any) -> None:
+        self.wait_until_finished()
+        # snapshot on the caller's thread: device->host transfer completes
+        # here, so the step loop may overwrite the arrays right away
+        snapshot = _host_snapshot(tree)
+
+        def _write():
+            try:
+                save_checkpoint(uri, snapshot)
+            except BaseException as e:  # ferried to the caller's thread
+                self._error = e
+                self._error_uri = uri
+
+        # non-daemon: interpreter shutdown joins the writer, so a script that
+        # exits right after save() still gets a complete final checkpoint
+        self._thread = threading.Thread(target=_write,
+                                        name="dmlc-ckpt-writer", daemon=False)
+        self._thread.start()
+
+    def wait_until_finished(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._error is not None:
+            err, uri = self._error, self._error_uri
+            self._error = self._error_uri = None
+            raise RuntimeError(f"async checkpoint to {uri!r} failed") from err
+
+
+def _host_snapshot(tree: Any) -> Any:
+    import jax
+
+    # np.array(copy=True): device arrays transfer, host arrays genuinely
+    # copy — np.asarray would alias a numpy input and let the caller's next
+    # step race the background write
+    return jax.tree_util.tree_map(lambda v: np.array(v, copy=True), tree)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention and latest-step resume.
+
+    Directory layout: ``{directory}/ckpt-{step:08d}`` over any URI-dispatched
+    store.  ``keep`` bounds how many past steps are retained (retention
+    deletes only on local paths; remote stores are expected to carry their
+    own lifecycle rules — a warning is logged once).  This is the
+    slice-granular resume story of SURVEY §5.3/§5.4: every process restarts,
+    finds ``latest_step()``, restores, continues.
+    """
+
+    _STEP_RE = re.compile(r"ckpt-(\d{8,})$")
+
+    def __init__(self, directory: str, keep: int = 3):
+        CHECK(keep >= 1, "keep must be >= 1")
+        self.directory = directory.rstrip("/")
+        self.keep = keep
+        self._async = AsyncCheckpointer()
+        self._warned_retention = False
+        self._is_local = "://" not in directory or \
+            directory.startswith("file://")
+
+    def _step_uri(self, step: int) -> str:
+        return f"{self.directory}/ckpt-{step:08d}"
+
+    def all_steps(self) -> List[int]:
+        from dmlc_core_tpu.io.filesys import URI, get_filesystem
+
+        base = URI(self.directory)
+        try:
+            infos = get_filesystem(base).list_directory(base)
+        except FileNotFoundError:
+            return []          # directory not created yet = no checkpoints;
+                               # other listing errors (auth, transient remote
+                               # failures) must propagate, not masquerade as
+                               # "start fresh"
+        steps = []
+        for info in infos:
+            m = self._STEP_RE.search(str(info.path))
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, tree: Any, async_: bool = True) -> None:
+        if self._is_local:
+            os.makedirs(self.directory.replace("file://", "", 1),
+                        exist_ok=True)
+        uri = self._step_uri(step)
+        if async_:
+            self._async.save(uri, tree)
+        else:
+            save_checkpoint(uri, tree)
+        log_info(f"checkpoint step {step} -> {uri}")
+        self._retain(step)
+
+    def restore(self, step: Optional[int] = None,
+                template: Any = None) -> Any:
+        self.wait_until_finished()
+        if step is not None:
+            return load_checkpoint(self._step_uri(step), template)
+        steps = self.all_steps()
+        CHECK(bool(steps), f"no checkpoints under {self.directory!r}")
+        # newest first, falling back past corrupt/truncated files (a remote
+        # store without atomic rename can expose a partial newest step)
+        last_err: Optional[BaseException] = None
+        for s in reversed(steps):
+            try:
+                return load_checkpoint(self._step_uri(s), template)
+            except Exception as e:
+                log_warning(f"checkpoint step {s} unreadable ({e}); "
+                            "falling back to previous step")
+                last_err = e
+        raise RuntimeError(
+            f"all checkpoints under {self.directory!r} are unreadable"
+        ) from last_err
+
+    def wait_until_finished(self) -> None:
+        self._async.wait_until_finished()
+
+    def _retain(self, current_step: int) -> None:
+        if not self._is_local:
+            # retention only deletes local checkpoints; skip the (remote)
+            # listing round-trip entirely on the hot save path
+            if not self._warned_retention:
+                log_warning("CheckpointManager retention only deletes local "
+                            "checkpoints; remote steps are left in place")
+                self._warned_retention = True
+            return
+        # include the step just scheduled: an async write may not be visible
+        # on disk yet, but it still counts toward (and is protected by)
+        # retention — only strictly older steps are ever deleted
+        steps = sorted(set(self.all_steps()) | {current_step})
+        excess = [s for s in steps[:-self.keep] if s != current_step]
+        for s in excess:
+            path = self._step_uri(s).replace("file://", "", 1)
+            try:
+                os.remove(path)
+            except OSError:
+                pass

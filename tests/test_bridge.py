@@ -1,5 +1,7 @@
 """JAX bridge tests: batching, mesh loader, URI checkpointing."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -142,3 +144,91 @@ def test_checkpoint_shape_mismatch(tmp_path):
     save_checkpoint(uri, {"w": np.zeros(3)})
     with pytest.raises(Exception, match="shape mismatch"):
         load_checkpoint(uri, template={"w": np.zeros(4)})
+
+
+def test_async_checkpointer_roundtrip(tmp_path):
+    from dmlc_core_tpu.bridge.checkpoint import AsyncCheckpointer
+
+    ck = AsyncCheckpointer()
+    tree = {"w": np.arange(10, dtype=np.float32), "step": np.int32(3)}
+    uri = str(tmp_path / "async.ckpt")
+    ck.save(uri, tree)
+    ck.wait_until_finished()
+    got = load_checkpoint(uri, template=jax.tree.map(np.zeros_like, tree))
+    np.testing.assert_array_equal(got["w"], tree["w"])
+    assert int(got["step"]) == 3
+
+
+def test_async_checkpointer_snapshot_isolated(tmp_path):
+    """Mutating state right after save must not corrupt the checkpoint."""
+    from dmlc_core_tpu.bridge.checkpoint import AsyncCheckpointer
+
+    ck = AsyncCheckpointer()
+    w = np.arange(1000, dtype=np.float32)
+    uri = str(tmp_path / "snap.ckpt")
+    ck.save(uri, {"w": w})
+    w += 999.0  # simulate the next training step
+    ck.wait_until_finished()
+    got = load_checkpoint(uri)
+    np.testing.assert_array_equal(next(iter(got.values())),
+                                  np.arange(1000, dtype=np.float32))
+
+
+def test_async_checkpointer_error_surfaces(tmp_path):
+    from dmlc_core_tpu.bridge.checkpoint import AsyncCheckpointer
+
+    ck = AsyncCheckpointer()
+    ck.save(str(tmp_path / "no-such-dir" / "x.ckpt"), {"w": np.zeros(2)})
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        ck.wait_until_finished()
+    # the error is consumed; the checkpointer is reusable afterwards
+    ck.save(str(tmp_path / "ok.ckpt"), {"w": np.zeros(2)})
+    ck.wait_until_finished()
+
+
+def test_checkpoint_manager_latest_and_retention(tmp_path):
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=2)
+    assert mgr.latest_step() is None
+    for step in (1, 5, 9):
+        mgr.save(step, {"w": np.full(4, float(step))}, async_=(step != 5))
+    mgr.wait_until_finished()
+    assert mgr.latest_step() == 9
+    assert mgr.all_steps() == [5, 9]          # step 1 aged out (keep=2)
+    got = mgr.restore(template={"w": np.zeros(4)})
+    np.testing.assert_array_equal(got["w"], np.full(4, 9.0))
+    got5 = mgr.restore(step=5, template={"w": np.zeros(4)})
+    np.testing.assert_array_equal(got5["w"], np.full(4, 5.0))
+
+
+def test_checkpoint_atomic_no_tmp_left(tmp_path):
+    uri = str(tmp_path / "a.ckpt")
+    save_checkpoint(uri, {"w": np.zeros(8)})
+    assert os.path.exists(uri)
+    assert not os.path.exists(uri + ".tmp")
+
+
+def test_checkpoint_manager_falls_back_past_corrupt_newest(tmp_path):
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=5)
+    mgr.save(1, {"w": np.full(3, 1.0)}, async_=False)
+    mgr.save(2, {"w": np.full(3, 2.0)}, async_=False)
+    # simulate a partial write surviving at the newest step
+    newest = tmp_path / "ckpts" / "ckpt-00000003"
+    newest.write_bytes(b"DMLCTPU1\x00")
+    assert mgr.latest_step() == 3
+    got = mgr.restore(template={"w": np.zeros(3)})
+    np.testing.assert_array_equal(got["w"], np.full(3, 2.0))
+
+
+def test_checkpoint_manager_wide_step_numbers(tmp_path):
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=10)
+    mgr.save(99_999_999, {"w": np.zeros(2)}, async_=False)
+    mgr.save(100_000_000, {"w": np.ones(2)}, async_=False)
+    assert mgr.latest_step() == 100_000_000
+    got = mgr.restore(template={"w": np.zeros(2)})
+    np.testing.assert_array_equal(got["w"], np.ones(2))
