@@ -53,6 +53,7 @@ __all__ = [
     "InputSplit",
     "InputSplitBase",
     "LineSplitter",
+    "NativeLineSplitter",
     "RecordIOSplitter",
     "IndexedRecordIOSplitter",
     "SingleFileSplit",
@@ -121,6 +122,59 @@ class InputSplit:
         return create_input_split(uri, part_index, num_parts, type, **kwargs)
 
 
+def _convert_to_uris(fs: fsys.FileSystem, uri: str) -> List[fsys.URI]:
+    """';'-list + regex-glob expansion (reference ConvertToURIs, .cc:95-146)."""
+    expanded: List[fsys.URI] = []
+    for token in uri.split(";"):
+        if not token:
+            continue
+        path = fsys.URI(token)
+        pos = path.name.rfind("/")
+        if pos < 0 or pos + 1 == len(path.name):
+            expanded.append(path)
+            continue
+        parent = path.copy()
+        parent.name = path.name[:pos]
+        try:
+            dfiles = fs.list_directory(parent)
+        except OSError:
+            expanded.append(path)
+            continue
+        stripped_target = path.name.rstrip("/")
+        exact = [f for f in dfiles if f.path.name.rstrip("/") == stripped_target]
+        if exact:
+            expanded.append(exact[0].path)
+            continue
+        # regex expansion against the directory listing
+        try:
+            pattern = re.compile(path.name)
+        except re.error as exc:
+            from dmlc_core_tpu.utils.logging import log_fatal
+            log_fatal(f"bad regex {path.name!r}: {exc}")
+        for f in dfiles:
+            if f.type != fsys.FileType.FILE or f.size == 0:
+                continue
+            if pattern.fullmatch(f.path.name.rstrip("/")):
+                expanded.append(f.path)
+    return expanded
+
+
+def _expand_input_files(fs: fsys.FileSystem, uri: str) -> List[fsys.FileInfo]:
+    """Expanded, non-empty input files for a (possibly ;-listed/glob) URI."""
+    files: List[fsys.FileInfo] = []
+    for path in _convert_to_uris(fs, uri):
+        info = fs.get_path_info(path)
+        if info.type == fsys.FileType.DIRECTORY:
+            for sub in fs.list_directory(info.path):
+                if sub.size != 0 and sub.type == fsys.FileType.FILE:
+                    files.append(sub)
+        elif info.size != 0:
+            files.append(info)
+    CHECK_NE(len(files), 0,
+             f"cannot find any files that match the URI pattern {uri!r}")
+    return files
+
+
 class InputSplitBase(InputSplit):
     """Byte-range sharding engine over a list of files."""
 
@@ -147,51 +201,10 @@ class InputSplitBase(InputSplit):
 
     # -- file-list expansion (reference ConvertToURIs, .cc:95-146) -----------
     def _convert_to_uris(self, uri: str) -> List[fsys.URI]:
-        expanded: List[fsys.URI] = []
-        for token in uri.split(";"):
-            if not token:
-                continue
-            path = fsys.URI(token)
-            pos = path.name.rfind("/")
-            if pos < 0 or pos + 1 == len(path.name):
-                expanded.append(path)
-                continue
-            parent = path.copy()
-            parent.name = path.name[:pos]
-            try:
-                dfiles = self._filesys.list_directory(parent)
-            except OSError:
-                expanded.append(path)
-                continue
-            stripped_target = path.name.rstrip("/")
-            exact = [f for f in dfiles if f.path.name.rstrip("/") == stripped_target]
-            if exact:
-                expanded.append(exact[0].path)
-                continue
-            # regex expansion against the directory listing
-            try:
-                pattern = re.compile(path.name)
-            except re.error as exc:
-                from dmlc_core_tpu.utils.logging import log_fatal
-                log_fatal(f"bad regex {path.name!r}: {exc}")
-            for f in dfiles:
-                if f.type != fsys.FileType.FILE or f.size == 0:
-                    continue
-                if pattern.fullmatch(f.path.name.rstrip("/")):
-                    expanded.append(f.path)
-        return expanded
+        return _convert_to_uris(self._filesys, uri)
 
     def _init_input_file_info(self, uri: str) -> None:
-        for path in self._convert_to_uris(uri):
-            info = self._filesys.get_path_info(path)
-            if info.type == fsys.FileType.DIRECTORY:
-                for sub in self._filesys.list_directory(info.path):
-                    if sub.size != 0 and sub.type == fsys.FileType.FILE:
-                        self._files.append(sub)
-            elif info.size != 0:
-                self._files.append(info)
-        CHECK_NE(len(self._files), 0,
-                 f"cannot find any files that match the URI pattern {uri!r}")
+        self._files.extend(_expand_input_files(self._filesys, uri))
 
     # -- partition math (reference ResetPartition, .cc:29-63) ----------------
     def reset_partition(self, part_index: int, num_parts: int) -> None:
@@ -335,6 +348,27 @@ class InputSplitBase(InputSplit):
         raise NotImplementedError
 
 
+def _next_line_record(cursor: ChunkCursor) -> Optional[memoryview]:
+    """Advance a cursor over a chunk of lines (reference line_split.cc:36-55)."""
+    if cursor.exhausted():
+        return None
+    data, pos = cursor.data, cursor.pos
+    ln = data.find(b"\n", pos)
+    lr = data.find(b"\r", pos)
+    if ln < 0:
+        p = lr if lr >= 0 else len(data)
+    elif lr < 0:
+        p = ln
+    else:
+        p = min(ln, lr)
+    rec = memoryview(data)[pos:p]
+    # skip the newline run (reference line_split.cc:42-45)
+    while p < len(data) and data[p] in (0x0A, 0x0D):
+        p += 1
+    cursor.pos = p
+    return rec
+
+
 class LineSplitter(InputSplitBase):
     """Record = line (reference src/io/line_split.cc)."""
 
@@ -368,23 +402,7 @@ class LineSplitter(InputSplitBase):
         return n + 1 if n > 0 else 0
 
     def extract_next_record(self, cursor: ChunkCursor) -> Optional[memoryview]:
-        if cursor.exhausted():
-            return None
-        data, pos = cursor.data, cursor.pos
-        ln = data.find(b"\n", pos)
-        lr = data.find(b"\r", pos)
-        if ln < 0:
-            p = lr if lr >= 0 else len(data)
-        elif lr < 0:
-            p = ln
-        else:
-            p = min(ln, lr)
-        rec = memoryview(data)[pos:p]
-        # skip the newline run (reference line_split.cc:42-45)
-        while p < len(data) and data[p] in (0x0A, 0x0D):
-            p += 1
-        cursor.pos = p
-        return rec
+        return _next_line_record(cursor)
 
 
 class RecordIOSplitter(InputSplitBase):
@@ -917,6 +935,74 @@ class InputSplitShuffle(InputSplit):
         self._source.close()
 
 
+class NativeLineSplitter(InputSplit):
+    """C++ line-split engine with built-in prefetch (native/input_split.cc).
+
+    Drop-in for ``ThreadedInputSplit(LineSplitter(...))`` over local files:
+    the chunk sharding/realignment loop AND the double-buffered read-ahead
+    run natively (reference src/io/input_split_base.cc +
+    threaded_input_split.h in one).  Selected by the factory when every
+    expanded file is local and the native core is built.
+    """
+
+    def __init__(self, fs: fsys.FileSystem, uri: str, part_index: int,
+                 num_parts: int):
+        from dmlc_core_tpu import native_bridge
+
+        # the Python engine's expansion (';'-lists, regex globs, directory
+        # walk), so file selection is identical in both paths
+        files = _expand_input_files(fs, uri)
+        self._paths = [info.path.name for info in files]
+        self._sizes = [info.size for info in files]
+        self._part, self._nparts = part_index, num_parts
+        self._buffer_size = DEFAULT_BUFFER_SIZE
+        self._native = native_bridge.NativeLineSplit(
+            self._paths, self._sizes, part_index, num_parts,
+            buffer_size=self._buffer_size)
+        self._cursor = ChunkCursor()
+
+    def before_first(self) -> None:
+        self._native.reset(self._part, self._nparts)
+        self._cursor = ChunkCursor()
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        # mirror ThreadedInputSplit: growing the hint reopens the engine with
+        # the larger chunk buffer (hints arrive before iteration starts)
+        if chunk_size <= self._buffer_size:
+            return
+        from dmlc_core_tpu import native_bridge
+
+        self._buffer_size = chunk_size
+        self._native.close()
+        self._native = native_bridge.NativeLineSplit(
+            self._paths, self._sizes, self._part, self._nparts,
+            buffer_size=self._buffer_size)
+        self._cursor = ChunkCursor()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        self._part, self._nparts = part_index, num_parts
+        self.before_first()
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._native.next_chunk()
+
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            rec = _next_line_record(self._cursor)
+            if rec is not None:
+                return rec
+            chunk = self._native.next_chunk()
+            if chunk is None:
+                return None
+            self._cursor = ChunkCursor(chunk)
+
+    def get_total_size(self) -> int:
+        return self._native.total_size()
+
+    def close(self) -> None:
+        self._native.close()
+
+
 def create_input_split(
     uri: str,
     part_index: int,
@@ -941,6 +1027,12 @@ def create_input_split(
     path = fsys.URI(spec.uri)
     fs = fsys.get_filesystem(path)
     if type == "text":
+        if (threaded and not spec.cache_file
+                and isinstance(fs, fsys.LocalFileSystem)):
+            from dmlc_core_tpu import native_bridge
+
+            if native_bridge.lsplit_available():
+                return NativeLineSplitter(fs, spec.uri, part_index, num_parts)
         split: InputSplitBase = LineSplitter(fs, spec.uri, part_index, num_parts)
     elif type == "recordio":
         split = RecordIOSplitter(fs, spec.uri, part_index, num_parts)

@@ -253,3 +253,92 @@ def recordio_frame(payloads: bytes, lens: np.ndarray
         return memoryview(out).cast("B"), offsets, nexc.value
     finally:
         lib.dmlc_tpu_frame_free(handle)
+
+
+# ---- native line-split engine (native/input_split.cc) ----------------------
+
+def _load_lsplit():
+    lib = _load()
+    if lib is None:
+        return None
+    if not hasattr(lib, "dmlc_tpu_lsplit_open"):
+        return None  # stale library built before input_split.cc existed
+    if not getattr(lib, "_lsplit_wired", False):
+        lib.dmlc_tpu_lsplit_open.restype = ctypes.c_void_p
+        lib.dmlc_tpu_lsplit_open.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+        lib.dmlc_tpu_lsplit_total.restype = ctypes.c_int64
+        lib.dmlc_tpu_lsplit_total.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_lsplit_reset.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.dmlc_tpu_lsplit_next_chunk.restype = ctypes.c_int64
+        lib.dmlc_tpu_lsplit_next_chunk.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+        lib.dmlc_tpu_lsplit_error.restype = ctypes.c_char_p
+        lib.dmlc_tpu_lsplit_error.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_lsplit_close.argtypes = [ctypes.c_void_p]
+        lib._lsplit_wired = True
+    return lib
+
+
+def lsplit_available() -> bool:
+    return _load_lsplit() is not None
+
+
+class NativeLineSplit:
+    """Handle over the C++ line-split engine (sharded read + prefetch thread).
+
+    ``next_chunk`` returns bytes of whole line records for the partition, or
+    None at the end.  ``reset`` re-partitions (or rewinds, with the same
+    arguments).
+    """
+
+    def __init__(self, paths, sizes, part: int, nparts: int,
+                 buffer_size: int = 8 << 20):
+        lib = _load_lsplit()
+        assert lib is not None
+        self._lib = lib
+        joined = "\n".join(paths).encode()
+        arr = (ctypes.c_int64 * len(sizes))(*sizes)
+        self._handle = lib.dmlc_tpu_lsplit_open(
+            joined, arr, len(sizes), part, nparts, buffer_size)
+        self._check()
+
+    def _require_open(self):
+        if self._handle is None:
+            raise ValueError("NativeLineSplit is closed")
+        return self._handle
+
+    def _check(self):
+        err = self._lib.dmlc_tpu_lsplit_error(self._require_open())
+        if err:
+            raise OSError(err.decode())
+
+    def total_size(self) -> int:
+        return self._lib.dmlc_tpu_lsplit_total(self._require_open())
+
+    def reset(self, part: int, nparts: int) -> None:
+        self._lib.dmlc_tpu_lsplit_reset(self._require_open(), part, nparts)
+        self._check()
+
+    def next_chunk(self):
+        ptr = ctypes.c_char_p()
+        n = self._lib.dmlc_tpu_lsplit_next_chunk(self._require_open(),
+                                                 ctypes.byref(ptr))
+        if n < 0:
+            self._check()
+        if n <= 0:
+            return None
+        return ctypes.string_at(ptr, n)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dmlc_tpu_lsplit_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -70,11 +70,15 @@ def test_line_split_all_parts_coverage(tmp_path, num_parts):
 
 
 def test_line_split_threaded_matches_plain(tmp_path):
+    from dmlc_core_tpu.io.input_split import NativeLineSplitter
+
     uri, all_lines = make_text_files(tmp_path)
     collected = []
     for part in range(4):
         split = create_input_split(uri, part, 4, "text")
-        assert isinstance(split, ThreadedInputSplit)
+        # prefetching default path: native engine when built, else the
+        # ThreadedInputSplit decorator over the Python engine
+        assert isinstance(split, (ThreadedInputSplit, NativeLineSplitter))
         collected.extend(collect_records(split))
         split.close()
     assert collected == all_lines
