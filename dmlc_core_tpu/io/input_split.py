@@ -175,6 +175,19 @@ def _expand_input_files(fs: fsys.FileSystem, uri: str) -> List[fsys.FileInfo]:
     return files
 
 
+def _next_record_from_chunks(holder, fetch_chunk: Callable, extract: Callable
+                             ) -> Optional[memoryview]:
+    """Shared drain-cursor-else-refill loop; ``holder`` owns ``._cursor``."""
+    while True:
+        rec = extract(holder._cursor)
+        if rec is not None:
+            return rec
+        chunk = fetch_chunk()
+        if chunk is None:
+            return None
+        holder._cursor = ChunkCursor(chunk)
+
+
 class InputSplitBase(InputSplit):
     """Byte-range sharding engine over a list of files."""
 
@@ -326,14 +339,8 @@ class InputSplitBase(InputSplit):
         return self.next_chunk_bytes()
 
     def next_record(self) -> Optional[memoryview]:
-        while True:
-            rec = self.extract_next_record(self._cursor)
-            if rec is not None:
-                return rec
-            chunk = self.next_chunk_bytes()
-            if chunk is None:
-                return None
-            self._cursor = ChunkCursor(chunk)
+        return _next_record_from_chunks(self, self.next_chunk_bytes,
+                                        self.extract_next_record)
 
     # -- per-format hooks ----------------------------------------------------
     def seek_record_begin(self, fs: Stream) -> int:
@@ -728,14 +735,8 @@ class ThreadedInputSplit(InputSplit):
         return self._iter.next()
 
     def next_record(self) -> Optional[memoryview]:
-        while True:
-            rec = self._base.extract_next_record(self._cursor)
-            if rec is not None:
-                return rec
-            chunk = self._iter.next()
-            if chunk is None:
-                return None
-            self._cursor = ChunkCursor(chunk)
+        return _next_record_from_chunks(self, self._iter.next,
+                                        self._base.extract_next_record)
 
     def close(self) -> None:
         self._iter.destroy()
@@ -842,14 +843,8 @@ class CachedInputSplit(InputSplit):
                 pass
 
     def next_record(self) -> Optional[memoryview]:
-        while True:
-            rec = self._base.extract_next_record(self._cursor)
-            if rec is not None:
-                return rec
-            chunk = self.next_chunk()
-            if chunk is None:
-                return None
-            self._cursor = ChunkCursor(chunk)
+        return _next_record_from_chunks(self, self.next_chunk,
+                                        self._base.extract_next_record)
 
     def close(self) -> None:
         self._iter.destroy()
@@ -966,18 +961,11 @@ class NativeLineSplitter(InputSplit):
         self._cursor = ChunkCursor()
 
     def hint_chunk_size(self, chunk_size: int) -> None:
-        # mirror ThreadedInputSplit: growing the hint reopens the engine with
-        # the larger chunk buffer (hints arrive before iteration starts)
-        if chunk_size <= self._buffer_size:
-            return
-        from dmlc_core_tpu import native_bridge
-
-        self._buffer_size = chunk_size
-        self._native.close()
-        self._native = native_bridge.NativeLineSplit(
-            self._paths, self._sizes, self._part, self._nparts,
-            buffer_size=self._buffer_size)
-        self._cursor = ChunkCursor()
+        # like the Python engines: grows the chunk buffer in place without
+        # disturbing the read position
+        if chunk_size > self._buffer_size:
+            self._buffer_size = chunk_size
+            self._native.hint_chunk_size(chunk_size)
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         self._part, self._nparts = part_index, num_parts
@@ -987,14 +975,8 @@ class NativeLineSplitter(InputSplit):
         return self._native.next_chunk()
 
     def next_record(self) -> Optional[memoryview]:
-        while True:
-            rec = _next_line_record(self._cursor)
-            if rec is not None:
-                return rec
-            chunk = self._native.next_chunk()
-            if chunk is None:
-                return None
-            self._cursor = ChunkCursor(chunk)
+        return _next_record_from_chunks(self, self._native.next_chunk,
+                                        _next_line_record)
 
     def get_total_size(self) -> int:
         return self._native.total_size()

@@ -266,8 +266,10 @@ def _load_lsplit():
     if not getattr(lib, "_lsplit_wired", False):
         lib.dmlc_tpu_lsplit_open.restype = ctypes.c_void_p
         lib.dmlc_tpu_lsplit_open.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+        lib.dmlc_tpu_lsplit_hint.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.dmlc_tpu_lsplit_total.restype = ctypes.c_int64
         lib.dmlc_tpu_lsplit_total.argtypes = [ctypes.c_void_p]
         lib.dmlc_tpu_lsplit_reset.argtypes = [
@@ -299,10 +301,12 @@ class NativeLineSplit:
         lib = _load_lsplit()
         assert lib is not None
         self._lib = lib
-        joined = "\n".join(paths).encode()
+        encoded = [p.encode() for p in paths]
+        blob = b"".join(encoded)     # length-delimited: any filename byte ok
+        lens = (ctypes.c_int64 * len(encoded))(*[len(e) for e in encoded])
         arr = (ctypes.c_int64 * len(sizes))(*sizes)
         self._handle = lib.dmlc_tpu_lsplit_open(
-            joined, arr, len(sizes), part, nparts, buffer_size)
+            blob, lens, arr, len(sizes), part, nparts, buffer_size)
         self._check()
 
     def _require_open(self):
@@ -321,6 +325,10 @@ class NativeLineSplit:
     def reset(self, part: int, nparts: int) -> None:
         self._lib.dmlc_tpu_lsplit_reset(self._require_open(), part, nparts)
         self._check()
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        """Grow the typical chunk size; read position is unaffected."""
+        self._lib.dmlc_tpu_lsplit_hint(self._require_open(), chunk_size)
 
     def next_chunk(self):
         ptr = ctypes.c_char_p()

@@ -10,6 +10,7 @@
 // two implementations record by record.
 
 #include <algorithm>
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -48,6 +49,7 @@ class LineSplitEngine {
 
   void ResetPartition(int64_t part, int64_t nparts) {
     StopPrefetch();
+    ClearError();  // a past transient failure must not poison future resets
     if (!DoResetPartition(part, nparts)) {
       // empty partition or failure: queue the end sentinel so PopChunk
       // never blocks waiting on a producer that was never started
@@ -101,11 +103,20 @@ class LineSplitEngine {
 
   // next chunk of whole records into out; false at partition end
   bool NextChunk(std::vector<char> *out) {
-    int64_t size = buffer_size_;
+    int64_t size = buffer_size_.load(std::memory_order_relaxed);
     while (true) {
       if (!ReadChunk(size, out)) return false;
       if (!out->empty()) return true;
       size *= 2;  // record larger than the buffer: grow and retry
+    }
+  }
+
+  // grow the typical chunk size without disturbing the read position
+  // (consumed by the prefetch thread at its next NextChunk)
+  void HintChunkSize(int64_t size) {
+    int64_t cur = buffer_size_.load(std::memory_order_relaxed);
+    while (size > cur &&
+           !buffer_size_.compare_exchange_weak(cur, size)) {
     }
   }
 
@@ -158,6 +169,11 @@ class LineSplitEngine {
   bool failed() const {
     std::lock_guard<std::mutex> lk(err_mu_);
     return !error_.empty();
+  }
+
+  void ClearError() {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    error_.clear();
   }
 
  private:
@@ -260,7 +276,7 @@ class LineSplitEngine {
 
   std::vector<FileEnt> files_;
   std::vector<int64_t> offsets_;
-  int64_t buffer_size_;
+  std::atomic<int64_t> buffer_size_;
   std::FILE *fp_ = nullptr;
   size_t file_ptr_ = 0;
   int64_t begin_ = 0, end_ = 0, curr_ = 0;
@@ -285,23 +301,29 @@ struct SplitHandle {
 
 extern "C" {
 
-// paths: '\n'-joined local file paths; sizes: per-file byte sizes
-void *dmlc_tpu_lsplit_open(const char *paths, const int64_t *sizes,
-                           int64_t nfiles, int64_t part, int64_t nparts,
+// paths: concatenated path bytes with per-path byte lengths in path_lens
+// (length-delimited, so any legal filename byte — incl. '\n' — is safe);
+// sizes: per-file byte sizes
+void *dmlc_tpu_lsplit_open(const char *paths, const int64_t *path_lens,
+                           const int64_t *sizes, int64_t nfiles,
+                           int64_t part, int64_t nparts,
                            int64_t buffer_size) {
   auto *h = new SplitHandle();
   std::vector<FileEnt> files;
   const char *p = paths;
   for (int64_t i = 0; i < nfiles; ++i) {
-    const char *q = std::strchr(p, '\n');
-    size_t len = q ? static_cast<size_t>(q - p) : std::strlen(p);
-    files.push_back({std::string(p, len), sizes[i]});
-    p = q ? q + 1 : p + len;
+    files.push_back({std::string(p, static_cast<size_t>(path_lens[i])),
+                     sizes[i]});
+    p += path_lens[i];
   }
   h->engine = new LineSplitEngine(std::move(files), buffer_size);
   h->engine->ResetPartition(part, nparts);
   if (h->engine->failed()) h->error = h->engine->Error();
   return h;
+}
+
+void dmlc_tpu_lsplit_hint(void *handle, int64_t chunk_size) {
+  static_cast<SplitHandle *>(handle)->engine->HintChunkSize(chunk_size);
 }
 
 int64_t dmlc_tpu_lsplit_total(void *handle) {
@@ -310,6 +332,7 @@ int64_t dmlc_tpu_lsplit_total(void *handle) {
 
 void dmlc_tpu_lsplit_reset(void *handle, int64_t part, int64_t nparts) {
   auto *h = static_cast<SplitHandle *>(handle);
+  h->error.clear();  // a reset retries cleanly after a transient failure
   h->engine->ResetPartition(part, nparts);
   if (h->engine->failed()) h->error = h->engine->Error();
 }

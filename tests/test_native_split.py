@@ -138,3 +138,39 @@ def test_large_randomized_all_parts(tmp_path):
         for part in range(nparts):
             python_parts += _records(LineSplitter(fs, uri, part, nparts))
         assert native_parts == python_parts
+
+
+def test_hint_mid_iteration_no_duplicates(tmp_path):
+    uri = _write_files(tmp_path, [b"".join(b"l%d\n" % i for i in range(100))])
+    fs = fsys.LocalFileSystem()
+    split = NativeLineSplitter(fs, uri, 0, 1)
+    first = [bytes(split.next_record()) for _ in range(10)]
+    split.hint_chunk_size(64 << 20)   # must not rewind
+    rest = _records(split)
+    assert first + rest == [b"l%d" % i for i in range(100)]
+
+
+def test_reset_clears_transient_error(tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_bytes(b"a\nb\n")
+    fs = fsys.LocalFileSystem()
+    split = NativeLineSplitter(fs, str(p), 0, 1)
+    assert _records_noclose(split) == [b"a", b"b"]
+    os.rename(p, tmp_path / "gone")
+    with pytest.raises(OSError):
+        split.reset_partition(0, 1)
+        while split.next_chunk() is not None:
+            pass
+    os.rename(tmp_path / "gone", p)
+    split.reset_partition(0, 1)       # recovers after the cause is fixed
+    assert _records_noclose(split) == [b"a", b"b"]
+    split.close()
+
+
+def _records_noclose(split):
+    out = []
+    while True:
+        r = split.next_record()
+        if r is None:
+            return out
+        out.append(bytes(r))
