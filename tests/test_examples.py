@@ -61,3 +61,20 @@ def test_train_gbdt_example(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "rows/sec" in proc.stdout
     assert ckpt.exists()
+
+
+def test_bench_pipeline_infeed_roundtrip(tmp_path, capsys):
+    """genrec -> infeed harness: every record lands on the device batches."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    try:
+        import bench_pipeline
+    finally:
+        sys.path.pop(0)
+
+    rec = str(tmp_path / "t.rec")
+    bench_pipeline.genrec(rec, records=1000, nbytes=64)
+    bench_pipeline.bench_infeed(rec, record_bytes=64, batch=128)
+    out = capsys.readouterr().out
+    assert "1000 records" in out
