@@ -51,10 +51,11 @@ class GBDTParam(Parameter):
     objective = field(str, default="logistic", enum=["logistic", "squared"],
                       help="loss")
     hist_method = field(str, default="auto",
-                        enum=["auto", "pallas", "onehot", "scatter"],
+                        enum=["auto", "pallas", "pallas_fused", "onehot", "scatter"],
                         help="histogram algorithm: VMEM-resident pallas "
-                             "kernel (TPU), one-hot MXU matmul, or "
-                             "segment-sum scatter (CPU)")
+                             "kernel (TPU; 'pallas_fused' also builds the "
+                             "node-weight matrix in-kernel), one-hot MXU "
+                             "matmul, or segment-sum scatter (CPU)")
 
 
 class TreeEnsemble(NamedTuple):
@@ -130,7 +131,7 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     import jax
 
     n_leaf = 2 ** max_depth
-    if method in ("onehot", "pallas"):
+    if method in ("onehot", "pallas", "pallas_fused"):
         # leaf sums as a (tiny) f32 matmul — TPU scatter-adds serialise
         leafhot = (node[:, None] == jnp.arange(n_leaf, dtype=node.dtype)
                    ).astype(jnp.float32)                 # [B, n_leaf]
@@ -187,7 +188,7 @@ class GBDT:
     # -- compiled round/predict ----------------------------------------------
     def _method(self, *arrays) -> str:
         method = resolve_hist_method(self.param.hist_method, *arrays)
-        if method == "pallas":
+        if method in ("pallas", "pallas_fused"):
             from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem
 
             # the kernel keeps the deepest level's [2n, F*nbins] f32
@@ -231,7 +232,7 @@ class GBDT:
             import jax.numpy as jnp
 
             n_rows = bins.shape[0]
-            if method == "pallas":
+            if method in ("pallas", "pallas_fused"):
                 from dmlc_core_tpu.ops.hist_pallas import BLOCK_ROWS
 
                 # pad rows to the kernel's tile multiple ONCE per fit (padded

@@ -150,7 +150,7 @@ def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,
     bins = jnp.asarray(bins)
     B, F = bins.shape
     method = resolve_hist_method(method, bins, grad)
-    if method == "pallas":
+    if method in ("pallas", "pallas_fused"):
         from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem
 
         if model_axis is not None or not hist_fits_vmem(num_nodes, F,
@@ -166,6 +166,11 @@ def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,
 
         G, H = grad_hist_pallas(bins, node_ids, grad, hess, num_nodes,
                                 num_bins)
+    elif method == "pallas_fused":
+        from dmlc_core_tpu.ops.hist_pallas import grad_hist_pallas_fused
+
+        G, H = grad_hist_pallas_fused(bins, node_ids, grad, hess, num_nodes,
+                                      num_bins)
     elif method == "onehot":
         if onehot is None:
             onehot = bin_onehot(bins, num_bins)

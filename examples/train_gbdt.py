@@ -26,9 +26,10 @@ def main():
     ap.add_argument("--num-bins", type=int, default=256)
     ap.add_argument("--learning-rate", type=float, default=0.3)
     ap.add_argument("--hist-method", default="auto",
-                    choices=["auto", "onehot", "scatter"],
-                    help="histogram algorithm (auto: MXU matmul on TPU, "
-                         "scatter on CPU)")
+                    choices=["auto", "pallas", "pallas_fused", "onehot",
+                             "scatter"],
+                    help="histogram algorithm (auto: pallas VMEM kernel on "
+                         "TPU, scatter on CPU)")
     ap.add_argument("--checkpoint", default="")
     args = ap.parse_args()
 

@@ -120,3 +120,31 @@ def test_gbdt_fit_pallas_matches_scatter_splits():
                                   np.asarray(ens_s.split_feat))
     np.testing.assert_allclose(np.asarray(margin_p), np.asarray(margin_s),
                                rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("b,f,nbins,nnodes", [
+    (256, 3, 8, 4),
+    (300, 5, 16, 2),      # padding path (pad rows carry node=-1)
+    (700, 2, 4, 12),      # multi-tile + non-power-of-two nodes
+])
+def test_fused_matches_scatter(b, f, nbins, nnodes):
+    bins, node, g, h = _rand_case(b, f, nbins, nnodes, seed=7)
+    G, H = hist_pallas.grad_hist_pallas_fused(bins, node, g, h, nnodes,
+                                              nbins)
+    Gr, Hr = grad_histogram(bins, node, g, h, nnodes, nbins,
+                            method="scatter")
+    assert G.shape == (nnodes, f, nbins)
+    np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(H), np.asarray(Hr),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_fused_matches_unfused():
+    bins, node, g, h = _rand_case(512, 4, 16, 8, seed=8)
+    Gf, Hf = hist_pallas.grad_hist_pallas_fused(bins, node, g, h, 8, 16)
+    Gu, Hu = hist_pallas.grad_hist_pallas(bins, node, g, h, 8, 16)
+    np.testing.assert_allclose(np.asarray(Gf), np.asarray(Gu),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(Hf), np.asarray(Hu),
+                               rtol=1e-5, atol=1e-5)
