@@ -78,3 +78,22 @@ def test_bench_pipeline_infeed_roundtrip(tmp_path, capsys):
     bench_pipeline.bench_infeed(rec, record_bytes=64, batch=128)
     out = capsys.readouterr().out
     assert "1000 records" in out
+
+
+@pytest.mark.slow
+def test_train_mlp_example(tmp_path):
+    rng = np.random.RandomState(1)
+    lines = []
+    for i in range(300):
+        x = rng.randn(6)
+        y = int(x[0] - x[1] > 0)
+        feats = " ".join(f"{j}:{x[j]:.4f}" for j in range(6))
+        lines.append(f"{y} {feats}")
+    data = tmp_path / "train.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+    proc = run_example(os.path.join(REPO, "examples", "train_mlp.py"),
+                       ["--data", str(data), "--num-feature", "6",
+                        "--hidden", "16", "--batch-size", "64",
+                        "--epochs", "1"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "epoch 0: loss=" in proc.stderr + proc.stdout

@@ -233,3 +233,30 @@ def test_local_backend_end_to_end(tmp_path):
     assert (tmp_path / "rank0.ok").exists()
     assert (tmp_path / "rank1.ok").exists()
     assert (tmp_path / "rank0.ok").read_text() == (tmp_path / "rank1.ok").read_text()
+
+
+def test_local_retry_recovers_crashing_worker(tmp_path):
+    """Fault injection the reference never had (SURVEY §5.3): a worker that
+    crashes on its first attempt must be retried and succeed."""
+    from dmlc_core_tpu.tracker.local import exec_cmd
+
+    marker = tmp_path / "attempted"
+    prog = tmp_path / "flaky.py"
+    prog.write_text(
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(3)\n"          # first attempt: crash
+        "sys.exit(0)\n")
+    exec_cmd([sys.executable, str(prog)], "worker", 0, {}, num_attempt=2)
+    assert marker.exists()
+
+
+def test_local_retry_exhaustion_raises(tmp_path):
+    from dmlc_core_tpu.tracker.local import exec_cmd
+
+    prog = tmp_path / "dead.py"
+    prog.write_text("import sys; sys.exit(7)\n")
+    with pytest.raises(RuntimeError, match="failed with exit 7"):
+        exec_cmd([sys.executable, str(prog)], "worker", 0, {}, num_attempt=2)
