@@ -8,22 +8,26 @@ north star.  The full stack is exercised (libsvm text -> parser -> RowBlock ->
 dense batch -> device binning -> jit'd boosting rounds); the timed region is
 training, matching how XGBoost reports hist rows/sec.
 
-vs_baseline = TPU rows/sec / single-host-CPU rows/sec on the same training
-workload, each device running its best hist formulation (VMEM-resident
-pallas hist kernel on TPU, segment-sum scatter on CPU — same
-splits/accuracy, different algorithm mapping).  The north-star target is
->=5x single-host.
+vs_baseline = accelerator rows/sec / single-host-CPU rows/sec on the same
+training workload, each device running its best hist formulation (VMEM-resident
+pallas hist kernel on TPU, segment-sum scatter on CPU — same splits/accuracy,
+different algorithm mapping).  The north-star target is >=5x single-host.
 
-Prints ONE JSON line.
+Driver-proofing (round-2 requirement, VERDICT.md item 1): TPU backend init has
+been observed to both raise UNAVAILABLE *and hang indefinitely* when the
+tunnel is down.  So the benchmark body runs in a re-exec'd subprocess with a
+hard wall-clock timeout; on accelerator failure the parent retries on
+JAX_PLATFORMS=cpu; a JSON line is ALWAYS printed and the exit code is 0 even
+on full fallback.  The JSON carries explicit "platform" and "tpu_available"
+fields so the driver can tell a real-chip number from a CPU fallback.
 """
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
-
-import numpy as np
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 200_000))
 N_FEATURES = 28
@@ -31,9 +35,32 @@ NUM_BINS = 256
 MAX_DEPTH = 6
 TPU_ROUNDS = int(os.environ.get("BENCH_TPU_ROUNDS", 10))
 CPU_ROUNDS = int(os.environ.get("BENCH_CPU_ROUNDS", 2))
+# Hard wall-clock budget for one child attempt.  First TPU compile is 20-40s;
+# a hung backend init is the failure mode this guards against.
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900))
+# Budget for the cheap "can the accelerator backend even init?" probe.
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", 300))
+JSON_TAG = "DMLC_BENCH_JSON:"
+# __file__ is undefined when this source is exec'd (e.g. via python -c); fall
+# back to the canonical repo-root location so the re-exec driver still works.
+SCRIPT_PATH = os.path.abspath(
+    globals().get("__file__", os.path.join(os.getcwd(), "bench.py")))
+
+
+def force_cpu_backend():
+    """Pin jax to the host CPU backend (the sitecustomize TPU plugin pins
+    jax_platforms via config, so the env var alone is not authoritative)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from dmlc_core_tpu.utils.platform import sync_platform_from_env
+
+    import jax  # noqa: F401  (must be imported before the config re-assert)
+
+    sync_platform_from_env()
 
 
 def make_higgs_like(n, f, seed=0):
+    import numpy as np
+
     rng = np.random.RandomState(seed)
     x = rng.randn(n, f).astype(np.float32)
     w = rng.randn(f).astype(np.float32)
@@ -60,9 +87,9 @@ def pipeline_smoke(tmpdir):
 
 
 def time_fit(model, bins, y, rounds, device, method):
-    """Time fit with each backend's best hist algorithm (onehot = MXU matmul
-    on TPU; scatter = segment_sum, the fastest CPU formulation)."""
+    """Time fit with each backend's best hist algorithm."""
     import jax
+    import numpy as np
 
     fit = model._fit_fn(rounds, method)
     b = jax.device_put(bins, device)
@@ -79,11 +106,27 @@ def time_fit(model, bins, y, rounds, device, method):
     return len(y) * rounds / elapsed, elapsed, acc
 
 
-def main():
+def run_probe():
+    """Child body: report which platform jax.devices() lands on."""
     import jax
 
+    d = jax.devices()[0]
+    # Touch the device so a half-alive tunnel fails here, not mid-benchmark.
+    import jax.numpy as jnp
+
+    jnp.ones((8, 8)).block_until_ready()
+    print(JSON_TAG + json.dumps({"platform": d.platform}), flush=True)
+
+
+def run_bench(force_cpu):
+    """Child body: run on whatever backend jax gives us, print tagged JSON."""
+    if force_cpu:
+        force_cpu_backend()
+    import jax
+    import numpy as np
+
     from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
-    from dmlc_core_tpu.ops.histogram import apply_bins
+    from dmlc_core_tpu.ops.histogram import apply_bins, resolve_hist_method
 
     with tempfile.TemporaryDirectory() as tmpdir:
         pipeline_smoke(tmpdir)
@@ -95,36 +138,101 @@ def main():
     model.make_bins(x[:50_000])
 
     accel = jax.devices()[0]
+    platform = accel.platform
+    on_accel = platform != "cpu"
     with jax.default_device(accel):
         bins = np.asarray(apply_bins(x, model.boundaries)).astype(np.int32)
 
-    from dmlc_core_tpu.ops.histogram import resolve_hist_method
-
     accel_method = resolve_hist_method("auto")
-    tpu_rps, tpu_s, acc = time_fit(model, bins, y, TPU_ROUNDS, accel,
-                                   accel_method)
+    accel_rounds = TPU_ROUNDS if on_accel else CPU_ROUNDS
+    accel_rps, accel_s, acc = time_fit(model, bins, y, accel_rounds, accel,
+                                       accel_method)
 
     # single-host CPU baseline on the identical workload (scatter is the
     # fastest CPU hist formulation; the pallas kernel is the fastest TPU one)
-    cpu = jax.devices("cpu")[0]
-    cpu_rps, cpu_s, _ = time_fit(model, bins, y, CPU_ROUNDS, cpu, "scatter")
+    if on_accel:
+        cpu = jax.devices("cpu")[0]
+        cpu_rps, cpu_s, _ = time_fit(model, bins, y, CPU_ROUNDS, cpu,
+                                     "scatter")
+    else:
+        cpu_rps = accel_rps  # vs_baseline := 1.0 — no accelerator this run
 
     result = {
         "metric": "gbdt_hist_train_rows_per_sec_per_chip",
-        "value": round(tpu_rps, 1),
+        "value": round(accel_rps, 1),
         "unit": (f"rows/sec ({N_ROWS} rows x {N_FEATURES} feat, "
                  f"depth-{MAX_DEPTH}, {NUM_BINS}-bin hist)"),
-        "vs_baseline": round(tpu_rps / cpu_rps, 3),
+        "vs_baseline": round(accel_rps / cpu_rps, 3),
+        "platform": platform,
+        "tpu_available": on_accel,
         "detail": {
             "device": str(accel),
-            "tpu_rounds": TPU_ROUNDS,
-            "tpu_seconds": round(tpu_s, 3),
+            "hist_method": accel_method,
+            "rounds": accel_rounds,
+            "seconds": round(accel_s, 3),
             "cpu_rows_per_sec": round(cpu_rps, 1),
             "train_acc": round(acc, 4),
         },
     }
-    print(json.dumps(result))
+    print(JSON_TAG + json.dumps(result), flush=True)
+
+
+def attempt(mode, timeout_s):
+    """Run a child stage once; return parsed JSON dict or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, SCRIPT_PATH, mode],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(SCRIPT_PATH) or ".",
+        )
+    except subprocess.TimeoutExpired:
+        print(f"bench child {mode} timed out after {timeout_s}s",
+              file=sys.stderr)
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith(JSON_TAG):
+            try:
+                return json.loads(line[len(JSON_TAG):])
+            except json.JSONDecodeError:
+                pass
+    tail = (proc.stderr or "")[-2000:]
+    print(f"bench child {mode} failed rc={proc.returncode}:\n{tail}",
+          file=sys.stderr)
+    return None
+
+
+def main():
+    # Stage 1: cheap probe — does the accelerator backend init at all?  The
+    # tunneled TPU plugin can hang indefinitely, hence the subprocess timeout.
+    probe = attempt("--probe", PROBE_TIMEOUT_S)
+    accel_ok = probe is not None and probe.get("platform") not in (None, "cpu")
+    result = None
+    if accel_ok:
+        result = attempt("--child", ATTEMPT_TIMEOUT_S)
+    if result is None:
+        # CPU fallback — pins jax_platforms=cpu inside the child, so it is
+        # never blocked on the TPU plugin.
+        result = attempt("--child-cpu", ATTEMPT_TIMEOUT_S)
+    if result is None:
+        # Even CPU failed (should not happen): still emit a valid JSON line.
+        result = {
+            "metric": "gbdt_hist_train_rows_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "rows/sec",
+            "vs_baseline": 0.0,
+            "platform": "none",
+            "tpu_available": False,
+            "detail": {"error": "all bench attempts failed; see stderr"},
+        }
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        run_probe()
+    elif "--child" in sys.argv:
+        run_bench(force_cpu=False)
+    elif "--child-cpu" in sys.argv:
+        run_bench(force_cpu=True)
+    else:
+        main()
