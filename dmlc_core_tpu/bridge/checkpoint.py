@@ -13,6 +13,7 @@ Header: {"leaves": [{"path": str, "dtype": str, "shape": [...]}, ...]}.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import re
@@ -44,7 +45,7 @@ def _is_local_uri(uri: str) -> bool:
 
 
 def _strip_file_scheme(uri: str) -> str:
-    return uri.replace("file://", "", 1)
+    return uri[len("file://"):] if uri.startswith("file://") else uri
 
 
 def save_checkpoint(uri: str, tree: Any) -> None:
@@ -65,7 +66,9 @@ def save_checkpoint(uri: str, tree: Any) -> None:
     target = uri
     local = _is_local_uri(uri)
     if local:
-        target = uri + ".tmp"
+        # pid-unique temp name: concurrent savers to the same URI must not
+        # interleave writes into one temp file and rename a torn mix
+        target = f"{uri}.tmp.{os.getpid()}"
     with create_stream(target, "w") as fo:
         fo.write(_MAGIC)
         fo.write_u64(len(header))
@@ -125,7 +128,13 @@ class AsyncCheckpointer:
         self._error: Optional[BaseException] = None
         self._error_uri: Optional[str] = None
 
-    def save(self, uri: str, tree: Any) -> None:
+    def save(self, uri: str, tree: Any, on_durable=None) -> None:
+        """Snapshot ``tree`` and write it in the background.
+
+        ``on_durable`` (optional) runs on the writer thread only after the
+        checkpoint bytes are fully committed — the hook retention uses so
+        older steps are never deleted while the new one is still in flight.
+        """
         self.wait_until_finished()
         # snapshot on the caller's thread: device->host transfer completes
         # here, so the step loop may overwrite the arrays right away
@@ -137,6 +146,15 @@ class AsyncCheckpointer:
             except BaseException as e:  # ferried to the caller's thread
                 self._error = e
                 self._error_uri = uri
+                return
+            if on_durable is not None:
+                try:
+                    on_durable()
+                except BaseException as e:
+                    # the checkpoint IS durable — a retention/hook failure
+                    # must not masquerade as a write failure and block restore
+                    log_warning(f"post-checkpoint hook for {uri!r} "
+                                f"failed: {e}")
 
         # non-daemon: interpreter shutdown joins the writer, so a script that
         # exits right after save() still gets a complete final checkpoint
@@ -211,16 +229,26 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, step: int, tree: Any, async_: bool = True) -> None:
-        if self._is_local:
-            os.makedirs(self.directory.replace("file://", "", 1),
-                        exist_ok=True)
         uri = self._step_uri(step)
+        if self._is_local:
+            os.makedirs(_strip_file_scheme(self.directory), exist_ok=True)
+            # sweep temp orphans a crashed previous writer of this step left
+            # behind (pid-unique temp names would otherwise accumulate)
+            for stale in glob.glob(_strip_file_scheme(uri) + ".tmp.*"):
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
         if async_:
-            self._async.save(uri, tree)
+            # retention runs on the writer thread only once the new step is
+            # durable — deleting older steps before that could leave zero
+            # restorable checkpoints if the in-flight write fails (keep=1)
+            self._async.save(uri, tree,
+                             on_durable=lambda: self._retain(step))
         else:
             save_checkpoint(uri, tree)
+            self._retain(step)
         log_info(f"checkpoint step {step} -> {uri}")
-        self._retain(step)
 
     def restore(self, step: Optional[int] = None,
                 template: Any = None) -> Any:
@@ -255,14 +283,15 @@ class CheckpointManager:
                             "checkpoints; remote steps are left in place")
                 self._warned_retention = True
             return
-        # include the step just scheduled: an async write may not be visible
-        # on disk yet, but it still counts toward (and is protected by)
-        # retention — only strictly older steps are ever deleted
+        # current_step is durable by the time retention runs (sync path, or
+        # the writer thread's on_durable hook); the union guards against a
+        # lagging directory listing — only strictly older steps are deleted
         steps = sorted(set(self.all_steps()) | {current_step})
         excess = [s for s in steps[:-self.keep] if s != current_step]
         for s in excess:
-            path = self._step_uri(s).replace("file://", "", 1)
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+            path = _strip_file_scheme(self._step_uri(s))
+            for victim in [path] + glob.glob(path + ".tmp.*"):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass

@@ -32,7 +32,8 @@ import functools
 import numpy as np
 
 __all__ = ["hist_matmul_pallas", "grad_hist_pallas",
-           "grad_hist_pallas_fused", "pallas_supported", "hist_fits_vmem",
+           "grad_hist_pallas_fused", "pallas_supported",
+           "pallas_fused_supported", "hist_fits_vmem",
            "BLOCK_ROWS"]
 
 # interpreter mode: runs the kernels on CPU for tests/debugging (flipped by
@@ -229,5 +230,31 @@ def pallas_supported() -> bool:
         out = jax.jit(lambda w, b: hist_matmul_pallas(w, b, 8,
                                                       block_rows=128))(w, bins)
         return bool(np.asarray(out)[0, 0] == 1.0)
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def pallas_fused_supported() -> bool:
+    """Probe the fused-W kernel separately from the plain one.
+
+    The fused kernel's in-VMEM bf16 concat at the n_pad=8 boundary (below the
+    16-sublane tile) can fail to lower on real Mosaic even when
+    :func:`hist_matmul_pallas` compiles — probing only the plain kernel would
+    let a user-selected ``pallas_fused`` crash at first use.
+    """
+    if not pallas_supported():
+        return False
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        bins = jnp.zeros((128, 2), jnp.int32)
+        node = jnp.zeros((128,), jnp.int32)
+        one = jnp.ones((128,), jnp.float32)
+        G, _ = jax.jit(lambda b, n, g, h: grad_hist_pallas_fused(
+            b, n, g, h, num_nodes=4, num_bins=8, block_rows=128))(
+                bins, node, one, one)
+        return bool(np.asarray(G)[0, 0, 0] == 128.0)
     except Exception:
         return False

@@ -160,6 +160,14 @@ def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,
             # either case the plain matmul (XLA-shardable, HBM-tiled) is
             # the right fallback.
             method = "onehot"
+    if method == "pallas_fused":
+        from dmlc_core_tpu.ops.hist_pallas import (pallas_fused_supported,
+                                                   pallas_supported)
+
+        if not pallas_fused_supported():
+            # the fused kernel can fail to lower on real Mosaic where the
+            # plain kernel still compiles (sub-16-sublane concat)
+            method = "pallas" if pallas_supported() else "onehot"
 
     if method == "pallas":
         from dmlc_core_tpu.ops.hist_pallas import grad_hist_pallas

@@ -9,6 +9,10 @@
 // bit-identical to the Python engine — the all-parts coverage tests diff the
 // two implementations record by record.
 
+#ifndef _FILE_OFFSET_BITS
+#define _FILE_OFFSET_BITS 64  // make off_t/fseeko 64-bit on 32-bit targets
+#endif
+
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
@@ -28,6 +32,16 @@ struct FileEnt {
   std::string path;
   int64_t size;
 };
+
+// 64-bit-safe absolute seek: std::fseek takes a long, which is 32 bits on
+// Windows and ILP32 builds — truncating offsets >= 2 GiB in large shards.
+inline int Seek64(std::FILE *fp, int64_t off) {
+#if defined(_WIN32)
+  return _fseeki64(fp, off, SEEK_SET);
+#else
+  return fseeko(fp, static_cast<off_t>(off), SEEK_SET);
+#endif
+}
 
 bool IsEol(unsigned char c) { return c == '\n' || c == '\r'; }
 
@@ -73,7 +87,7 @@ class LineSplitEngine {
     if (end_ != offsets_[fend]) {
       std::FILE *fp = std::fopen(files_[fend].path.c_str(), "rb");
       if (!fp) { Fail("cannot open " + files_[fend].path); return false; }
-      std::fseek(fp, static_cast<long>(end_ - offsets_[fend]), SEEK_SET);
+      Seek64(fp, end_ - offsets_[fend]);
       end_ += SeekRecordBegin(fp);
       std::fclose(fp);
     }
@@ -81,8 +95,7 @@ class LineSplitEngine {
     file_ptr_ = UpperBound(begin_);
     if (!OpenFile(file_ptr_)) return false;
     if (begin_ != offsets_[file_ptr_]) {
-      std::fseek(fp_, static_cast<long>(begin_ - offsets_[file_ptr_]),
-                 SEEK_SET);
+      Seek64(fp_, begin_ - offsets_[file_ptr_]);
       begin_ += SeekRecordBegin(fp_);
     }
     BeforeFirst();
@@ -96,7 +109,7 @@ class LineSplitEngine {
       file_ptr_ = fptr;
       if (!OpenFile(file_ptr_)) return;
     }
-    std::fseek(fp_, static_cast<long>(begin_ - offsets_[file_ptr_]), SEEK_SET);
+    Seek64(fp_, begin_ - offsets_[file_ptr_]);
     curr_ = begin_;
     overflow_.clear();
   }

@@ -206,7 +206,69 @@ def test_checkpoint_atomic_no_tmp_left(tmp_path):
     uri = str(tmp_path / "a.ckpt")
     save_checkpoint(uri, {"w": np.zeros(8)})
     assert os.path.exists(uri)
-    assert not os.path.exists(uri + ".tmp")
+    # pid-unique temp (concurrent savers must not share one temp file) and
+    # nothing left behind after the rename
+    assert list(tmp_path.glob("a.ckpt.tmp*")) == []
+
+
+def test_checkpoint_retention_waits_for_async_durability(tmp_path, monkeypatch):
+    """keep=1 + a failing async write must never delete the last good step."""
+    import dmlc_core_tpu.bridge.checkpoint as ckpt_mod
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=1)
+    mgr.save(1, {"w": np.full(2, 1.0)}, async_=False)
+    assert mgr.all_steps() == [1]
+
+    def boom(uri, tree):
+        raise OSError("injected write failure")
+
+    monkeypatch.setattr(ckpt_mod, "save_checkpoint", boom)
+    mgr.save(2, {"w": np.full(2, 2.0)}, async_=True)
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        mgr.wait_until_finished()
+    monkeypatch.undo()
+    # step 1 must still be restorable: retention may only run after the new
+    # step is durable
+    assert mgr.all_steps() == [1]
+    got = mgr.restore(template={"w": np.zeros(2)})
+    np.testing.assert_array_equal(got["w"], np.full(2, 1.0))
+    # and a successful async save ages step 1 out once durable
+    mgr.save(3, {"w": np.full(2, 3.0)}, async_=True)
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [3]
+
+
+def test_checkpoint_retention_failure_does_not_mask_durable_write(
+        tmp_path, monkeypatch):
+    """A post-write retention error must not make restore() refuse a durable
+    checkpoint."""
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=1)
+    monkeypatch.setattr(mgr, "_retain",
+                        lambda step: (_ for _ in ()).throw(OSError("boom")))
+    mgr.save(1, {"w": np.full(2, 1.0)}, async_=True)
+    mgr.wait_until_finished()          # must NOT raise: the write succeeded
+    got = mgr.restore(template={"w": np.zeros(2)})
+    np.testing.assert_array_equal(got["w"], np.full(2, 1.0))
+
+
+def test_checkpoint_orphan_temps_swept(tmp_path):
+    """pid-unique temps from crashed writers are cleaned, not accumulated."""
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    d = tmp_path / "ckpts"
+    d.mkdir()
+    # orphans: a crashed writer of step 1, and of a step that will age out
+    (d / "ckpt-00000001.tmp.9999").write_bytes(b"torn")
+    (d / "ckpt-00000000.tmp.1234").write_bytes(b"torn")
+    (d / "ckpt-00000000").write_bytes(b"DMLCTPU1\x00")   # old partial step
+    mgr = CheckpointManager(str(d), keep=1)
+    mgr.save(1, {"w": np.zeros(2)}, async_=False)
+    assert not (d / "ckpt-00000001.tmp.9999").exists()   # swept at save
+    assert not (d / "ckpt-00000000.tmp.1234").exists()   # swept at retention
+    assert mgr.all_steps() == [1]
 
 
 def test_checkpoint_manager_falls_back_past_corrupt_newest(tmp_path):

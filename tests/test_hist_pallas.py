@@ -16,9 +16,11 @@ from dmlc_core_tpu.ops.histogram import grad_histogram
 def interpret_mode():
     hist_pallas._INTERPRET = True
     hist_pallas.pallas_supported.cache_clear()
+    hist_pallas.pallas_fused_supported.cache_clear()
     yield
     hist_pallas._INTERPRET = False
     hist_pallas.pallas_supported.cache_clear()
+    hist_pallas.pallas_fused_supported.cache_clear()
 
 
 def _rand_case(b, f, nbins, nnodes, seed=0):
@@ -148,3 +150,21 @@ def test_fused_matches_unfused():
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(Hf), np.asarray(Hu),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_probe_gates_method(monkeypatch):
+    """A user-selected pallas_fused falls back when the fused kernel's probe
+    fails (ADVICE r1: fused may not lower on real Mosaic where the plain
+    kernel does) — and never crashes at first use."""
+    bins, node, g, h = _rand_case(256, 3, 8, 4, seed=9)
+    monkeypatch.setattr(hist_pallas, "pallas_fused_supported", lambda: False)
+    G, H = grad_histogram(bins, node, g, h, 4, 8, method="pallas_fused")
+    Gr, Hr = grad_histogram(bins, node, g, h, 4, 8, method="scatter")
+    np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(H), np.asarray(Hr),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_fused_probe_passes_in_interpret_mode():
+    assert hist_pallas.pallas_fused_supported() is True
