@@ -48,6 +48,35 @@ def _strip_file_scheme(uri: str) -> str:
     return uri[len("file://"):] if uri.startswith("file://") else uri
 
 
+def _sweep_orphan_temps(base_path: str) -> None:
+    """Remove ``{base_path}.tmp.<pid>`` files whose writer process is dead.
+
+    Live writers (including this process's own in-flight async write, and
+    concurrent savers in other processes) are left alone — the pid in the
+    temp name is exactly what distinguishes a crash orphan from an active
+    write.
+    """
+    for stale in glob.glob(base_path + ".tmp.*"):
+        suffix = stale.rsplit(".", 1)[-1]
+        try:
+            pid = int(suffix)
+        except ValueError:
+            pid = None
+        if pid is not None:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                pass               # dead writer: sweep
+            except OSError:
+                continue           # e.g. EPERM: pid exists, leave it
+            else:
+                continue           # live writer, leave it
+        try:
+            os.remove(stale)
+        except OSError:
+            pass
+
+
 def save_checkpoint(uri: str, tree: Any) -> None:
     """Write a pytree of arrays/scalars to ``uri``.
 
@@ -233,12 +262,9 @@ class CheckpointManager:
         if self._is_local:
             os.makedirs(_strip_file_scheme(self.directory), exist_ok=True)
             # sweep temp orphans a crashed previous writer of this step left
-            # behind (pid-unique temp names would otherwise accumulate)
-            for stale in glob.glob(_strip_file_scheme(uri) + ".tmp.*"):
-                try:
-                    os.remove(stale)
-                except OSError:
-                    pass
+            # behind (pid-unique temp names would otherwise accumulate);
+            # live writers' temps are skipped
+            _sweep_orphan_temps(_strip_file_scheme(uri))
         if async_:
             # retention runs on the writer thread only once the new step is
             # durable — deleting older steps before that could leave zero
@@ -290,8 +316,8 @@ class CheckpointManager:
         excess = [s for s in steps[:-self.keep] if s != current_step]
         for s in excess:
             path = _strip_file_scheme(self._step_uri(s))
-            for victim in [path] + glob.glob(path + ".tmp.*"):
-                try:
-                    os.remove(victim)
-                except OSError:
-                    pass
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            _sweep_orphan_temps(path)

@@ -186,18 +186,29 @@ class GBDT:
         return apply_bins(x, self.boundaries)
 
     # -- compiled round/predict ----------------------------------------------
-    def _method(self, *arrays) -> str:
+    def _method(self, *arrays, batch: Optional[int] = None) -> str:
         method = resolve_hist_method(self.param.hist_method, *arrays)
         if method in ("pallas", "pallas_fused"):
-            from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem
+            from dmlc_core_tpu.ops.hist_pallas import (hist_fits_vmem,
+                                                       sharded_hist_plan)
 
             # the kernel keeps the deepest level's [2n, F*nbins] f32
             # accumulator resident in VMEM; decide up front so the onehot
-            # fallback still amortises its matmul RHS across rounds
+            # fallback still amortises its matmul RHS across rounds.
+            # ``batch`` is the row count grad_histogram will actually see
+            # (padded for fit, raw for boost_round) so this gate and the
+            # in-trace one in grad_histogram cannot disagree.
             deepest = 2 ** (self.param.max_depth - 1)
-            if (self.model_axis is not None
-                    or not hist_fits_vmem(deepest, self.num_feature,
-                                          self.param.num_bins)):
+            if self.model_axis is not None:
+                # model-sharded hist keeps the kernel via shard_map when an
+                # ambient mesh is set and features split evenly; each shard
+                # then only holds an F/mp slice of the accumulator
+                if sharded_hist_plan(self.model_axis, self.num_feature,
+                                     deepest, self.param.num_bins,
+                                     batch=batch) is None:
+                    method = "onehot"
+            elif not hist_fits_vmem(deepest, self.num_feature,
+                                    self.param.num_bins):
                 method = "onehot"
         return method
 
@@ -295,12 +306,18 @@ class GBDT:
         weight = (jnp.ones(bins.shape[0], jnp.float32)
                   if weight is None else jnp.asarray(weight))
         bins = jnp.asarray(bins)
-        return self._fit_fn(self.param.num_boost_round, self._method(bins))(
+        from dmlc_core_tpu.ops.hist_pallas import BLOCK_ROWS
+
+        # fit pads rows to the kernel tile before the hist sees them
+        padded = -(-bins.shape[0] // BLOCK_ROWS) * BLOCK_ROWS
+        return self._fit_fn(self.param.num_boost_round,
+                            self._method(bins, batch=padded))(
             bins, jnp.asarray(label, jnp.float32), weight)
 
     def boost_round(self, margin, bins, label, weight):
         """One boosting round (the unit train step for streaming/bench)."""
-        return self._round_fn(self._method(bins, margin))(
+        return self._round_fn(self._method(bins, margin,
+                                           batch=bins.shape[0]))(
             margin, bins, label, weight)
 
     def predict_margin(self, ensemble: TreeEnsemble, bins):

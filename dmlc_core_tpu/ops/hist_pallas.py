@@ -32,9 +32,10 @@ import functools
 import numpy as np
 
 __all__ = ["hist_matmul_pallas", "grad_hist_pallas",
-           "grad_hist_pallas_fused", "pallas_supported",
+           "grad_hist_pallas_fused", "grad_hist_pallas_sharded",
+           "ambient_mesh", "sharded_hist_plan", "pallas_supported",
            "pallas_fused_supported", "hist_fits_vmem",
-           "BLOCK_ROWS"]
+           "BLOCK_ROWS", "DATA_AXIS"]
 
 # interpreter mode: runs the kernels on CPU for tests/debugging (flipped by
 # tests, or set DMLC_TPU_PALLAS_INTERPRET=1 to debug without a chip)
@@ -213,6 +214,108 @@ def grad_hist_pallas_fused(bins, node_ids, grad, hess, num_nodes: int,
         interpret=_INTERPRET,
     )(node, g, h, bins)
     return _split_gh(out, n_pad, num_nodes, bf, num_bins)
+
+
+# mesh axis name the whole package shards batch rows over (parallel/mesh.py
+# data_sharding default); the sharded hist uses it for its psum axis
+DATA_AXIS = "data"
+
+
+def ambient_mesh():
+    """The Mesh of an enclosing ``with mesh:`` block, or None.
+
+    grad_histogram reads this at trace time to shard_map the kernel for
+    model-parallel runs; callers opt in simply by tracing under their mesh
+    (the convention every sharded path in this package already follows).
+    Guarded: if a jax upgrade moves the thread-resources accessor, model-
+    sharded callers degrade to the onehot fallback instead of crashing.
+    """
+    try:
+        from jax._src import mesh as mesh_lib
+
+        m = mesh_lib.thread_resources.env.physical_mesh
+    except Exception:
+        try:
+            from jax.interpreters import pxla
+
+            m = pxla.thread_resources.env.physical_mesh
+        except Exception:
+            return None
+    return None if m.empty else m
+
+
+def sharded_hist_plan(model_axis, num_feature: int, num_nodes: int,
+                      num_bins: int, batch=None, mesh=None):
+    """The mesh to shard_map the hist kernel over, or None to fall back.
+
+    Single source of truth for the model-sharded-pallas gate (used by both
+    ``grad_histogram`` and ``GBDT._method`` so the two can't drift): requires
+    an ambient (or given) mesh carrying ``model_axis``, features dividing
+    evenly across it, rows dividing across the data axis (``batch=None``
+    skips that check for callers that pad rows later), and the per-shard
+    ``F/mp`` accumulator slice fitting VMEM.
+    """
+    if model_axis is None:
+        return None
+    if mesh is None:
+        mesh = ambient_mesh()
+    if mesh is None:
+        return None
+    mp = mesh.shape.get(model_axis)
+    dp = mesh.shape.get(DATA_AXIS, 1)
+    if (mp is None or num_feature % mp != 0
+            or (batch is not None and batch % dp != 0)
+            or not hist_fits_vmem(num_nodes, num_feature // mp, num_bins)):
+        return None
+    return mesh
+
+
+def grad_hist_pallas_sharded(bins, node_ids, grad, hess, num_nodes: int,
+                             num_bins: int, mesh, model_axis: str,
+                             data_axis: str = DATA_AXIS,
+                             fused: bool = False):
+    """shard_map-wrapped VMEM hist: rows dp-sharded, features model-sharded.
+
+    Keeps the Pallas kernel under tensor parallelism (SURVEY §2.9) instead of
+    falling back to the HBM-tiled one-hot matmul: each model shard slices its
+    own ``F/mp`` feature columns (bins arrive feature-replicated), runs the
+    VMEM kernel on its local row shard, and psums partial histograms over the
+    data axis.  Output is ``P(None, model_axis, None)`` — exactly the
+    constraint the GSPMD path advertises, so split-finding code downstream is
+    unchanged.
+
+    Requires ``F % mesh.shape[model_axis] == 0``; callers check this (and the
+    per-shard VMEM fit) before dispatching here.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    F = bins.shape[1]
+    mp = mesh.shape[model_axis]
+    f_local = F // mp
+    row_axis = data_axis if data_axis in mesh.shape else None
+    inner = grad_hist_pallas_fused if fused else grad_hist_pallas
+
+    def local_hist(b, n, g, h):
+        idx = jax.lax.axis_index(model_axis)
+        b_local = jax.lax.dynamic_slice_in_dim(b, idx * f_local, f_local,
+                                               axis=1)
+        G, H = inner(b_local, n.astype(jnp.int32), g, h, num_nodes, num_bins)
+        if row_axis is not None:
+            G = jax.lax.psum(G, row_axis)
+            H = jax.lax.psum(H, row_axis)
+        return G, H
+
+    out_spec = P(None, model_axis, None)
+    return jax.shard_map(
+        local_hist, mesh=mesh,
+        in_specs=(P(row_axis, None), P(row_axis), P(row_axis), P(row_axis)),
+        out_specs=(out_spec, out_spec),
+        # pallas_call's out_shape carries no vma annotation; the psum above
+        # already makes the outputs data-axis-invariant, so skip the check
+        check_vma=False,
+    )(bins, node_ids, grad, hess)
 
 
 @functools.lru_cache(maxsize=None)

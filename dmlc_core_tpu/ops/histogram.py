@@ -150,16 +150,6 @@ def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,
     bins = jnp.asarray(bins)
     B, F = bins.shape
     method = resolve_hist_method(method, bins, grad)
-    if method in ("pallas", "pallas_fused"):
-        from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem
-
-        if model_axis is not None or not hist_fits_vmem(num_nodes, F,
-                                                        num_bins):
-            # pallas_call is not GSPMD-partitionable, and the kernel keeps
-            # the whole [2n, F*nbins] accumulator resident in VMEM; in
-            # either case the plain matmul (XLA-shardable, HBM-tiled) is
-            # the right fallback.
-            method = "onehot"
     if method == "pallas_fused":
         from dmlc_core_tpu.ops.hist_pallas import (pallas_fused_supported,
                                                    pallas_supported)
@@ -168,8 +158,32 @@ def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,
             # the fused kernel can fail to lower on real Mosaic where the
             # plain kernel still compiles (sub-16-sublane concat)
             method = "pallas" if pallas_supported() else "onehot"
+    sharded_mesh = None
+    if method in ("pallas", "pallas_fused"):
+        from dmlc_core_tpu.ops.hist_pallas import (hist_fits_vmem,
+                                                   sharded_hist_plan)
 
-    if method == "pallas":
+        if model_axis is None:
+            # the kernel keeps the whole [2n, F*nbins] accumulator resident
+            # in VMEM; beyond that the plain matmul (HBM-tiled) must take over
+            if not hist_fits_vmem(num_nodes, F, num_bins):
+                method = "onehot"
+        else:
+            # model-sharded: pallas_call is not GSPMD-partitionable, but the
+            # kernel stays on via shard_map — each model shard runs it on its
+            # own F/mp feature slice (and only that slice must fit VMEM)
+            sharded_mesh = sharded_hist_plan(model_axis, F, num_nodes,
+                                             num_bins, batch=B)
+            if sharded_mesh is None:
+                method = "onehot"
+
+    if method in ("pallas", "pallas_fused") and sharded_mesh is not None:
+        from dmlc_core_tpu.ops.hist_pallas import grad_hist_pallas_sharded
+
+        G, H = grad_hist_pallas_sharded(
+            bins, node_ids, grad, hess, num_nodes, num_bins, sharded_mesh,
+            model_axis, fused=(method == "pallas_fused"))
+    elif method == "pallas":
         from dmlc_core_tpu.ops.hist_pallas import grad_hist_pallas
 
         G, H = grad_hist_pallas(bins, node_ids, grad, hess, num_nodes,

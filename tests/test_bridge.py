@@ -255,19 +255,29 @@ def test_checkpoint_retention_failure_does_not_mask_durable_write(
 
 
 def test_checkpoint_orphan_temps_swept(tmp_path):
-    """pid-unique temps from crashed writers are cleaned, not accumulated."""
+    """pid-unique temps from crashed (dead-pid) writers are cleaned; temps
+    owned by live processes are left alone."""
+    import subprocess
+    import sys
+
     from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
 
     d = tmp_path / "ckpts"
     d.mkdir()
-    # orphans: a crashed writer of step 1, and of a step that will age out
-    (d / "ckpt-00000001.tmp.9999").write_bytes(b"torn")
-    (d / "ckpt-00000000.tmp.1234").write_bytes(b"torn")
-    (d / "ckpt-00000000").write_bytes(b"DMLCTPU1\x00")   # old partial step
+    # a genuinely dead pid: spawn-and-reap a child
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    dead = proc.pid
+    live = 1     # init: always alive (kill(1, 0) -> EPERM counts as alive)
+    (d / f"ckpt-00000001.tmp.{dead}").write_bytes(b"torn")   # crash orphan
+    (d / f"ckpt-00000001.tmp.{live}").write_bytes(b"live")   # in-flight writer
+    (d / f"ckpt-00000000.tmp.{dead}").write_bytes(b"torn")
+    (d / "ckpt-00000000").write_bytes(b"DMLCTPU1\x00")       # old partial step
     mgr = CheckpointManager(str(d), keep=1)
     mgr.save(1, {"w": np.zeros(2)}, async_=False)
-    assert not (d / "ckpt-00000001.tmp.9999").exists()   # swept at save
-    assert not (d / "ckpt-00000000.tmp.1234").exists()   # swept at retention
+    assert not (d / f"ckpt-00000001.tmp.{dead}").exists()    # swept at save
+    assert (d / f"ckpt-00000001.tmp.{live}").exists()        # live: preserved
+    assert not (d / f"ckpt-00000000.tmp.{dead}").exists()    # swept at retain
     assert mgr.all_steps() == [1]
 
 

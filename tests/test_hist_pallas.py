@@ -168,3 +168,108 @@ def test_fused_probe_gates_method(monkeypatch):
 
 def test_fused_probe_passes_in_interpret_mode():
     assert hist_pallas.pallas_fused_supported() is True
+
+
+def _mesh_2d(data=4, model=2):
+    import jax
+    from dmlc_core_tpu.parallel.mesh import make_mesh
+
+    return make_mesh({"data": data, "model": model},
+                     devices=jax.devices()[:data * model])
+
+
+def test_sharded_pallas_matches_scatter():
+    """Model-sharded hist keeps the pallas kernel via shard_map (VERDICT r1
+    item 3) and matches the exact scatter result."""
+    import jax
+
+    bins, node, g, h = _rand_case(256, 8, 16, 4, seed=11)
+    mesh = _mesh_2d()
+    calls = []
+    orig = hist_pallas.grad_hist_pallas_sharded
+
+    def spy(*args, **kwargs):
+        calls.append(kwargs.get("fused"))
+        return orig(*args, **kwargs)
+
+    hist_pallas.grad_hist_pallas_sharded = spy
+    try:
+        with mesh:
+            G, H = jax.jit(lambda *a: grad_histogram(
+                *a, 4, 16, model_axis="model", method="pallas"))(
+                    bins, node, g, h)
+            G, H = np.asarray(G), np.asarray(H)
+    finally:
+        hist_pallas.grad_hist_pallas_sharded = orig
+    assert calls == [False], "sharded pallas path was not taken"
+    Gr, Hr = grad_histogram(bins, node, g, h, 4, 16, method="scatter")
+    np.testing.assert_allclose(G, np.asarray(Gr), rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(H, np.asarray(Hr), rtol=2e-2, atol=2e-2)
+
+
+def test_sharded_pallas_fused_variant():
+    import jax
+
+    bins, node, g, h = _rand_case(512, 4, 8, 6, seed=12)
+    mesh = _mesh_2d()
+    with mesh:
+        G, H = jax.jit(lambda *a: grad_histogram(
+            *a, 6, 8, model_axis="model", method="pallas_fused"))(
+                bins, node, g, h)
+        G = np.asarray(G)
+    Gr, _ = grad_histogram(bins, node, g, h, 6, 8, method="scatter")
+    np.testing.assert_allclose(G, np.asarray(Gr), rtol=2e-2, atol=2e-2)
+
+
+def test_sharded_pallas_uneven_features_falls_back():
+    """F not divisible by the model axis must fall back, not crash."""
+    import jax
+
+    bins, node, g, h = _rand_case(256, 7, 8, 4, seed=13)   # 7 % 2 != 0
+    mesh = _mesh_2d()
+    with mesh:
+        G, _ = jax.jit(lambda *a: grad_histogram(
+            *a, 4, 8, model_axis="model", method="pallas"))(bins, node, g, h)
+        G = np.asarray(G)
+    Gr, _ = grad_histogram(bins, node, g, h, 4, 8, method="scatter")
+    np.testing.assert_allclose(G, np.asarray(Gr), rtol=2e-2, atol=2e-2)
+
+
+def test_gbdt_model_sharded_keeps_pallas():
+    """Under an ambient mesh, a model-sharded GBDT resolves to pallas and
+    trains on the kernel path end-to-end."""
+    import jax
+    import jax.numpy as jnp
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+    from dmlc_core_tpu.parallel.mesh import data_sharding
+
+    mesh = _mesh_2d()
+    rng = np.random.RandomState(5)
+    B, F = 64, 8
+    x = rng.randn(B, F).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    model = GBDT(GBDTParam(num_boost_round=2, max_depth=3, num_bins=16,
+                           hist_method="pallas"), num_feature=F,
+                 model_axis="model")
+    model.make_bins(x)
+    with mesh:
+        assert model._method() == "pallas"
+        bins = jax.device_put(model.bin_features(x),
+                              data_sharding(mesh, ndim=2))
+        label = jax.device_put(jnp.asarray(y), data_sharding(mesh, ndim=1))
+        weight = jax.device_put(jnp.ones(B, jnp.float32),
+                                data_sharding(mesh, ndim=1))
+        margin = jax.device_put(jnp.zeros(B, jnp.float32),
+                                data_sharding(mesh, ndim=1))
+        new_margin, _ = model.boost_round(margin, bins, label, weight)
+        new_margin = np.asarray(new_margin)
+    assert np.isfinite(new_margin).all()
+    # same trees as the unsharded scatter fit
+    ref = GBDT(GBDTParam(num_boost_round=2, max_depth=3, num_bins=16,
+                         hist_method="scatter"), num_feature=F)
+    ref.boundaries = model.boundaries
+    rm, _ = ref.boost_round(jnp.zeros(B, jnp.float32),
+                            jnp.asarray(model.bin_features(x)),
+                            jnp.asarray(y), jnp.ones(B, jnp.float32))
+    np.testing.assert_allclose(new_margin, np.asarray(rm), rtol=5e-2,
+                               atol=5e-2)
