@@ -48,29 +48,43 @@ def _strip_file_scheme(uri: str) -> str:
     return uri[len("file://"):] if uri.startswith("file://") else uri
 
 
-def _sweep_orphan_temps(base_path: str) -> None:
-    """Remove ``{base_path}.tmp.<pid>`` files whose writer process is dead.
+def _temp_suffix() -> str:
+    """Host+pid writer tag for temp names: pid liveness is only decidable on
+    the writing host, so the host must be part of the name."""
+    import socket
 
-    Live writers (including this process's own in-flight async write, and
-    concurrent savers in other processes) are left alone — the pid in the
-    temp name is exactly what distinguishes a crash orphan from an active
-    write.
+    return f"{socket.gethostname()}.{os.getpid()}"
+
+
+def _sweep_orphan_temps(base_path: str) -> None:
+    """Remove ``{base_path}.tmp.<host>.<pid>`` files whose writer is dead.
+
+    Liveness (``kill(pid, 0)``) is only meaningful for temps written on THIS
+    host; another host's in-flight temp on a shared filesystem must never be
+    classified dead by a local pid probe, so foreign-host temps are left
+    alone (they are cleaned by their own host's next save/retention pass).
     """
-    for stale in glob.glob(base_path + ".tmp.*"):
-        suffix = stale.rsplit(".", 1)[-1]
+    import socket
+
+    host = socket.gethostname()
+    prefix = base_path + ".tmp."
+    for stale in glob.glob(prefix + "*"):
+        rest = stale[len(prefix):]          # "<host>.<pid>" (legacy: "<pid>")
+        tmp_host, _, pid_s = rest.rpartition(".")
+        if tmp_host and tmp_host != host:
+            continue                        # foreign host: cannot test pid
         try:
-            pid = int(suffix)
+            pid = int(pid_s)
         except ValueError:
-            pid = None
-        if pid is not None:
-            try:
-                os.kill(pid, 0)
-            except ProcessLookupError:
-                pass               # dead writer: sweep
-            except OSError:
-                continue           # e.g. EPERM: pid exists, leave it
-            else:
-                continue           # live writer, leave it
+            continue                        # unrecognized name: leave it
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            pass                            # dead writer: sweep
+        except OSError:
+            continue                        # e.g. EPERM: pid exists
+        else:
+            continue                        # live writer, leave it
         try:
             os.remove(stale)
         except OSError:
@@ -95,9 +109,10 @@ def save_checkpoint(uri: str, tree: Any) -> None:
     target = uri
     local = _is_local_uri(uri)
     if local:
-        # pid-unique temp name: concurrent savers to the same URI must not
-        # interleave writes into one temp file and rename a torn mix
-        target = f"{uri}.tmp.{os.getpid()}"
+        # host+pid-unique temp name: concurrent savers to the same URI (even
+        # across hosts on a shared filesystem) must not interleave writes
+        # into one temp file and rename a torn mix
+        target = f"{uri}.tmp.{_temp_suffix()}"
     with create_stream(target, "w") as fo:
         fo.write(_MAGIC)
         fo.write_u64(len(header))

@@ -53,6 +53,7 @@ _state: dict = {
     "distributed": False,
     "mesh": None,
     "version": 0,
+    "fn_cache": {},
 }
 
 _OPS = {"sum": np.add, "max": np.maximum, "min": np.minimum, "prod": np.multiply}
@@ -101,7 +102,8 @@ def finalize() -> None:
             jax.distributed.shutdown()
         except Exception:
             pass
-    _state.update(initialized=False, distributed=False, mesh=None)
+    _state.update(initialized=False, distributed=False, mesh=None,
+                  fn_cache={})
 
 
 def is_initialized() -> bool:
@@ -130,6 +132,25 @@ def get_processor_name() -> str:
     return socket.gethostname()
 
 
+def _proc_slots(devices, nproc: int) -> np.ndarray:
+    """One representative device slot per process rank, in rank order.
+
+    ``devices`` is the mesh's world-axis device sequence.  Device enumeration
+    is NOT guaranteed process-major (or process-uniform) on real multi-host
+    topologies, so the slot of rank p is derived from each device's actual
+    ``process_index`` — never from stride arithmetic.
+    """
+    slots = np.full(nproc, -1, dtype=np.int64)
+    for i, d in enumerate(devices):
+        p = d.process_index
+        if 0 <= p < nproc and slots[p] < 0:
+            slots[p] = i
+    CHECK(bool((slots >= 0).all()),
+          f"mesh devices cover only {int((slots >= 0).sum())}/{nproc} "
+          "processes; every rank must own at least one device")
+    return slots
+
+
 def _global_op(value: np.ndarray, op: str, root: Optional[int] = None,
                gather: bool = False) -> np.ndarray:
     """Shared engine: stack per-process contributions on a leading axis,
@@ -141,41 +162,48 @@ def _global_op(value: np.ndarray, op: str, root: Optional[int] = None,
     _require_init()
     value = np.asarray(value)
     nproc = jax.process_count()
+    if root is not None:
+        CHECK(0 <= root < nproc, f"root {root} out of range for {nproc} ranks")
     if nproc == 1:
         if gather:
             return value[None]
-        if root is not None:
-            return value
         return value
     mesh = _state["mesh"]
-    ndev = mesh.devices.size
-    per_proc = ndev // nproc
-    # leading axis = device slots; each process replicates its value into its
-    # local slots so the global array's shard on process p holds value_p.
-    local = np.broadcast_to(value[None], (per_proc,) + value.shape)
+    devs = list(mesh.devices.reshape(-1))
+    ndev = len(devs)
+    # leading axis = device slots; each process replicates its value into
+    # every slot it owns, so the global array's shard on any of process p's
+    # devices holds value_p.  Local slot count comes from the actual device->
+    # process mapping (processes need not own equal device counts).
+    n_local = sum(1 for d in devs if d.process_index == jax.process_index())
+    local = np.broadcast_to(value[None], (n_local,) + value.shape)
     sharding = NamedSharding(mesh, P("world"))
     garr = jax.make_array_from_process_local_data(sharding, local,
                                                   (ndev,) + value.shape)
     out_sharding = NamedSharding(mesh, P())
-    if gather:
-        # take one slot per process: slots are process-major
-        fn = jax.jit(lambda x: x[::per_proc],
-                     out_shardings=NamedSharding(mesh, P()))
-        return np.asarray(fn(garr))
-    if root is not None:
-        fn = jax.jit(lambda x: x[root * per_proc],
-                     out_shardings=out_sharding)
-        return np.asarray(fn(garr))
-    reducers = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min, "prod": jnp.prod}
-    CHECK(op in reducers, f"unknown reduce op {op!r}")
-    red = reducers[op]
-    # each process's value appears per_proc times; correct for duplication
-    if op == "sum":
-        fn = jax.jit(lambda x: red(x[::per_proc], axis=0), out_shardings=out_sharding)
-    elif op == "prod":
-        fn = jax.jit(lambda x: red(x[::per_proc], axis=0), out_shardings=out_sharding)
-    else:
-        fn = jax.jit(lambda x: red(x, axis=0), out_shardings=out_sharding)
+    slots = _proc_slots(devs, nproc)   # one slot per rank, rank order
+    # compiled-dispatch cache: a fresh lambda per call would defeat jit's
+    # function-identity cache and retrace two collectives per broadcast
+    mode = ("gather" if gather else
+            ("root", root) if root is not None else ("red", op))
+    key = (mode, ndev, tuple(slots.tolist()), value.shape, str(value.dtype))
+    fn = _state["fn_cache"].get(key)
+    if fn is None:
+        if gather:
+            fn = jax.jit(lambda x: x[slots], out_shardings=out_sharding)
+        elif root is not None:
+            r = int(slots[root])
+            fn = jax.jit(lambda x: x[r], out_shardings=out_sharding)
+        else:
+            reducers = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min,
+                        "prod": jnp.prod}
+            CHECK(op in reducers, f"unknown reduce op {op!r}")
+            red = reducers[op]
+            # reduce over exactly one slot per process (duplicates dropped
+            # uniformly for every op)
+            fn = jax.jit(lambda x: red(x[slots], axis=0),
+                         out_shardings=out_sharding)
+        _state["fn_cache"][key] = fn
     return np.asarray(fn(garr))
 
 
@@ -185,10 +213,65 @@ def allreduce(value: Any, op: str = "sum") -> np.ndarray:
     return _global_op(np.asarray(value), op)
 
 
-def broadcast(value: Any, root: int = 0) -> np.ndarray:
+# dtype codes for the broadcast shape/dtype header (fixed order — part of the
+# cross-rank wire contract; append only).  The payload itself travels as raw
+# uint8 bytes, so 64-bit dtypes survive even though the device path
+# canonicalizes to 32 bits when jax_enable_x64 is off (ranks are assumed
+# same-endian, as on any homogeneous TPU/CPU fleet).
+_BCAST_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
+                 "float16", "uint32", "uint64", "int8", "int16", "uint16",
+                 "complex64", "complex128"]
+_BCAST_MAX_NDIM = 8
+_BCAST_ERR = -1   # header[0] sentinel: root-side validation failed
+
+
+def broadcast(value: Any = None, root: int = 0) -> np.ndarray:
     """Broadcast ``value`` from ``root`` to all ranks (rabit::Broadcast).
-    Every rank must pass an array of the same shape/dtype."""
-    return _global_op(np.asarray(value), "sum", root=root)
+
+    Only ``root`` needs to supply data — matching rabit's semantics; other
+    ranks may pass ``None`` (the shape/dtype travel in a fixed-size header
+    round first).  A non-None value on a non-root rank is ignored.
+    """
+    _require_init()
+    rank = get_rank()
+    if get_world_size() == 1:
+        CHECK(value is not None, "broadcast root must supply a value")
+        return np.asarray(value)
+    # header round: root validates FIRST but always participates — a
+    # root-side error is shipped as a sentinel so the other ranks raise too
+    # instead of hanging in the collective
+    header = np.zeros(2 + _BCAST_MAX_NDIM, np.int32)
+    root_err: Optional[str] = None
+    if rank == root:
+        if value is None:
+            root_err = "broadcast root must supply a value"
+        else:
+            value = np.asarray(value)
+            if value.ndim > _BCAST_MAX_NDIM:
+                root_err = f"broadcast supports ndim <= {_BCAST_MAX_NDIM}"
+            elif str(value.dtype) not in _BCAST_DTYPES:
+                root_err = f"unsupported broadcast dtype {value.dtype}"
+        if root_err is None:
+            header[0] = _BCAST_DTYPES.index(str(value.dtype))
+            header[1] = value.ndim
+            header[2:2 + value.ndim] = value.shape
+        else:
+            header[0] = _BCAST_ERR
+    header = _global_op(header, "sum", root=root)
+    if int(header[0]) == _BCAST_ERR:
+        CHECK(False, root_err or
+              f"broadcast root {root} failed validation; see its log")
+    dtype = np.dtype(_BCAST_DTYPES[int(header[0])])
+    shape = tuple(int(s) for s in header[2:2 + int(header[1])])
+    nbytes = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+    if rank == root:
+        payload = np.frombuffer(
+            np.ascontiguousarray(value.astype(dtype, copy=False)).tobytes(),
+            dtype=np.uint8)
+    else:
+        payload = np.zeros(nbytes, np.uint8)   # shape carrier; ignored
+    out = _global_op(payload, "sum", root=root)
+    return np.frombuffer(out.tobytes(), dtype=dtype).reshape(shape)
 
 
 def allgather(value: Any) -> np.ndarray:

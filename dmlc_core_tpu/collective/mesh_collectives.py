@@ -17,7 +17,6 @@ built-in ``psum`` beats a hand-rolled ring — it should, and bench.py verifies.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import numpy as np
@@ -36,6 +35,16 @@ class MeshCollective:
         self.mesh = mesh
         self.axis = axis
         self.axis_size = mesh.shape[axis]
+        # compiled-fn cache lives on the instance (NOT functools.lru_cache on
+        # bound methods, which pins self/mesh in a global cache forever — a
+        # leak in long-lived jobs that build many meshes)
+        self._fn_cache: dict = {}
+
+    def _cached(self, key, builder):
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            fn = self._fn_cache[key] = builder()
+        return fn
 
     def _shard_map(self, fn, in_spec, out_spec):
         import jax
@@ -46,8 +55,11 @@ class MeshCollective:
         return jax.jit(shard_map(
             fn, mesh=self.mesh, in_specs=in_spec, out_specs=out_spec))
 
-    @functools.lru_cache(maxsize=None)
     def _allreduce_fn(self, op: str):
+        return self._cached(("allreduce", op),
+                            lambda: self._build_allreduce(op))
+
+    def _build_allreduce(self, op: str):
         import jax
         import jax.lax as lax
         from jax.sharding import PartitionSpec as P
@@ -70,8 +82,10 @@ class MeshCollective:
         holds the reduced value.  Input dim 0 must equal the axis size."""
         return self._allreduce_fn(op)(x)
 
-    @functools.lru_cache(maxsize=None)
     def _psum_scalar_fn(self):
+        return self._cached("psum", self._build_psum)
+
+    def _build_psum(self):
         import jax.lax as lax
         from jax.sharding import PartitionSpec as P
 
@@ -91,8 +105,10 @@ class MeshCollective:
 
         return self._psum_scalar_fn()(x)
 
-    @functools.lru_cache(maxsize=None)
     def _allgather_fn(self):
+        return self._cached("allgather", self._build_allgather)
+
+    def _build_allgather(self):
         import jax.lax as lax
         from jax.sharding import PartitionSpec as P
 
@@ -107,8 +123,10 @@ class MeshCollective:
         """All-gather shards: output dim0 = axis_size * x.dim0 per shard."""
         return self._allgather_fn()(x)
 
-    @functools.lru_cache(maxsize=None)
     def _reduce_scatter_fn(self):
+        return self._cached("reduce_scatter", self._build_reduce_scatter)
+
+    def _build_reduce_scatter(self):
         import jax.lax as lax
         from jax.sharding import PartitionSpec as P
 
@@ -127,8 +145,11 @@ class MeshCollective:
         holds slice i of the sum (elems must divide by axis_size)."""
         return self._reduce_scatter_fn()(x)
 
-    @functools.lru_cache(maxsize=None)
     def _broadcast_fn(self, root: int):
+        return self._cached(("broadcast", root),
+                            lambda: self._build_broadcast(root))
+
+    def _build_broadcast(self, root: int):
         import jax.lax as lax
         from jax.sharding import PartitionSpec as P
 

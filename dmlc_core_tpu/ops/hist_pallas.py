@@ -291,6 +291,8 @@ def grad_hist_pallas_sharded(bins, node_ids, grad, hess, num_nodes: int,
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from dmlc_core_tpu.parallel.compat import shard_map_unchecked
+
     F = bins.shape[1]
     mp = mesh.shape[model_axis]
     f_local = F // mp
@@ -308,13 +310,12 @@ def grad_hist_pallas_sharded(bins, node_ids, grad, hess, num_nodes: int,
         return G, H
 
     out_spec = P(None, model_axis, None)
-    return jax.shard_map(
-        local_hist, mesh=mesh,
+    # unchecked variant: pallas_call's out_shape carries no vma annotation;
+    # the psum above already makes the outputs data-axis-invariant
+    return shard_map_unchecked(
+        local_hist, mesh,
         in_specs=(P(row_axis, None), P(row_axis), P(row_axis), P(row_axis)),
         out_specs=(out_spec, out_spec),
-        # pallas_call's out_shape carries no vma annotation; the psum above
-        # already makes the outputs data-axis-invariant, so skip the check
-        check_vma=False,
     )(bins, node_ids, grad, hess)
 
 

@@ -12,3 +12,15 @@ def get_shard_map():
     from jax.experimental.shard_map import shard_map  # type: ignore
 
     return shard_map
+
+
+def shard_map_unchecked(fn, mesh, in_specs, out_specs):
+    """shard_map with the output-sharding check disabled, across the kwarg
+    rename (``check_vma`` today, ``check_rep`` before jax 0.6)."""
+    sm = get_shard_map()
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)

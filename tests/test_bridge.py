@@ -267,16 +267,23 @@ def test_checkpoint_orphan_temps_swept(tmp_path):
     # a genuinely dead pid: spawn-and-reap a child
     proc = subprocess.Popen([sys.executable, "-c", "pass"])
     proc.wait()
-    dead = proc.pid
-    live = 1     # init: always alive (kill(1, 0) -> EPERM counts as alive)
+    import socket
+
+    host = socket.gethostname()
+    dead = f"{host}.{proc.pid}"
+    live = f"{host}.1"   # init: always alive (kill(1,0)->EPERM counts alive)
+    foreign = f"other-host.{proc.pid}"   # dead pid but NOT this host
     (d / f"ckpt-00000001.tmp.{dead}").write_bytes(b"torn")   # crash orphan
     (d / f"ckpt-00000001.tmp.{live}").write_bytes(b"live")   # in-flight writer
+    (d / f"ckpt-00000001.tmp.{foreign}").write_bytes(b"?")   # foreign host
     (d / f"ckpt-00000000.tmp.{dead}").write_bytes(b"torn")
     (d / "ckpt-00000000").write_bytes(b"DMLCTPU1\x00")       # old partial step
     mgr = CheckpointManager(str(d), keep=1)
     mgr.save(1, {"w": np.zeros(2)}, async_=False)
     assert not (d / f"ckpt-00000001.tmp.{dead}").exists()    # swept at save
     assert (d / f"ckpt-00000001.tmp.{live}").exists()        # live: preserved
+    # foreign host's temp: local pid probe is meaningless -> preserved
+    assert (d / f"ckpt-00000001.tmp.{foreign}").exists()
     assert not (d / f"ckpt-00000000.tmp.{dead}").exists()    # swept at retain
     assert mgr.all_steps() == [1]
 
