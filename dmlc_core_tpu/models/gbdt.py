@@ -427,12 +427,12 @@ class GBDT:
 
         flat = load_checkpoint(uri)
 
-        # keys are keystr paths like "['split_feat']"
+        # keys are jax.tree_util.keystr paths; save_model writes a flat dict,
+        # so each key is exactly "['<name>']" — match it exactly (a substring
+        # match would alias e.g. 'split_feat' with any future key containing
+        # that text)
         def get(name):
-            for k, v in flat.items():
-                if name in k:
-                    return v
-            raise KeyError(name)
+            return flat[f"['{name}']"]
 
         self.boundaries = np.asarray(get("boundaries"), dtype=np.float32)
         return TreeEnsemble(get("split_feat"), get("split_bin"),

@@ -86,9 +86,14 @@ def _ring_attention_local(q, k, v, axis: str, axis_size: int, causal: bool,
     o0 = jnp.zeros((B, Lq, H, D), jnp.float32)
     m0 = jnp.full((B, H, Lq), neg_inf, jnp.float32)
     l0 = jnp.zeros((B, H, Lq), jnp.float32)
-    if hasattr(lax, "pvary"):
-        # mark the fresh accumulators as device-varying over the ring axis so
-        # the scan carry type matches the per-shard outputs (jax >= 0.6 vma)
+    # mark the fresh accumulators as device-varying over the ring axis so the
+    # scan carry type matches the per-shard outputs (jax >= 0.6 vma).  pcast
+    # is the current spelling; pvary its deprecated predecessor (probe pcast
+    # FIRST — jax 0.9 fires the DeprecationWarning even on hasattr(pvary)).
+    if hasattr(lax, "pcast"):
+        o0, m0, l0 = (lax.pcast(x, (axis,), to="varying")
+                      for x in (o0, m0, l0))
+    elif hasattr(lax, "pvary"):
         o0, m0, l0 = (lax.pvary(x, (axis,)) for x in (o0, m0, l0))
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
                                   jnp.arange(axis_size))

@@ -273,3 +273,21 @@ def test_gbdt_model_sharded_keeps_pallas():
                             jnp.asarray(y), jnp.ones(B, jnp.float32))
     np.testing.assert_allclose(new_margin, np.asarray(rm), rtol=5e-2,
                                atol=5e-2)
+
+
+def test_ambient_mesh_probe_on_current_jax():
+    """The ambient-mesh accessor reaches into jax internals
+    (hist_pallas.ambient_mesh); if a jax upgrade moves it, the model-sharded
+    kernel would silently degrade to onehot.  Pin the probe directly."""
+    mesh = _mesh_2d()
+    assert hist_pallas.ambient_mesh() is None
+    with mesh:
+        m = hist_pallas.ambient_mesh()
+        assert m is not None, (
+            "ambient_mesh() lost the enclosing mesh on jax "
+            + __import__("jax").__version__)
+        assert m.shape["model"] == 2
+        # and the single-source-of-truth gate selects the kernel with it
+        assert hist_pallas.sharded_hist_plan("model", 8, 4, 16,
+                                             batch=256) is m
+    assert hist_pallas.ambient_mesh() is None
