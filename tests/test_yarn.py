@@ -1,17 +1,26 @@
-"""YARN backend test against a mocked ResourceManager REST endpoint."""
+"""YARN backend submit test against a mocked ResourceManager REST endpoint.
+
+Supervision semantics (retry/blacklist/abort) are covered in
+test_yarn_supervisor.py; this test drives the full ``submit()`` entry point
+and checks the per-task application submissions (one app per worker, the
+REST recast of the reference AM's one-container-per-task model).
+"""
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-import pytest
-
 from dmlc_core_tpu.tracker.opts import get_opts
 
 
 class MockRM:
+    """All apps run on node0 and succeed after one RUNNING poll."""
+
     def __init__(self):
         self.submissions = []
+        self.polls = {}
+        self._lock = threading.Lock()
+        self._n = 0
 
     def start(self):
         store = self
@@ -22,24 +31,37 @@ class MockRM:
             def log_message(self, *args):
                 pass
 
-            def do_POST(self):
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
-                if self.path.endswith("new-application"):
-                    out = json.dumps({"application-id": "app_123",
-                                      "maximum-resource-capability":
-                                          {"memory": 8192, "vCores": 4}}).encode()
-                    self.send_response(200)
-                elif self.path.endswith("/apps"):
-                    store.submissions.append(json.loads(body))
-                    out = b""
-                    self.send_response(202)
-                else:
-                    out = b""
-                    self.send_response(404)
+            def _reply(self, status, obj):
+                out = json.dumps(obj).encode() if obj is not None else b""
+                self.send_response(status)
                 self.send_header("Content-Length", str(len(out)))
                 self.end_headers()
                 self.wfile.write(out)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                with store._lock:
+                    if self.path.endswith("new-application"):
+                        app_id = f"app_{store._n}"
+                        store._n += 1
+                        self._reply(200, {"application-id": app_id})
+                    elif self.path.endswith("/apps"):
+                        store.submissions.append(json.loads(body))
+                        self._reply(202, None)
+                    else:
+                        self._reply(404, None)
+
+            def do_GET(self):
+                with store._lock:
+                    app_id = self.path.rsplit("/", 1)[-1]
+                    n = store.polls.get(app_id, 0)
+                    store.polls[app_id] = n + 1
+                    state, final = (("RUNNING", "UNDEFINED") if n == 0
+                                    else ("FINISHED", "SUCCEEDED"))
+                    self._reply(200, {"app": {
+                        "state": state, "finalStatus": final,
+                        "amHostHttpAddress": "node0:8042"}})
 
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.port = self.server.server_address[1]
@@ -71,20 +93,34 @@ def test_yarn_submit(monkeypatch):
             return orig(opts_, fun, wait=False)
 
         monkeypatch.setattr(yarn, "submit_job", no_wait)
+        monkeypatch.setattr(yarn, "supervise",
+                            _fast_supervise(yarn.supervise))
         yarn.submit(opts)
-        assert len(rm.submissions) == 1
-        sub = rm.submissions[0]
-        assert sub["application-id"] == "app_123"
-        assert sub["application-name"] == "test-job"
-        assert sub["max-app-attempts"] == 3
-        assert sub["resource"] == {"memory": 2048, "vCores": 2}
-        env = {e["key"]: e["value"]
-               for e in sub["am-container-spec"]["environment"]["entry"]}
-        assert env["DMLC_NUM_WORKER"] == "4"
-        assert "DMLC_TRACKER_URI" in env
-        assert "DMLC_COORDINATOR_PORT" in env
-        cmd = sub["am-container-spec"]["commands"]["command"]
-        assert "dmlc_core_tpu.tracker.launcher" in cmd
-        assert "python train.py" in cmd
+
+        # one application per worker task
+        assert len(rm.submissions) == 4
+        for i, sub in enumerate(rm.submissions):
+            assert sub["application-id"] == f"app_{i}"
+            assert sub["application-name"] == f"test-job[{i}]:worker"
+            # the supervisor owns retries; the RM must not re-run the AM
+            assert sub["max-app-attempts"] == 1
+            assert sub["resource"] == {"memory": 2048, "vCores": 2}
+            env = {e["key"]: e["value"]
+                   for e in sub["am-container-spec"]["environment"]["entry"]}
+            assert env["DMLC_NUM_WORKER"] == "4"
+            assert "DMLC_TRACKER_URI" in env
+            assert "DMLC_COORDINATOR_PORT" in env
+            cmd = sub["am-container-spec"]["commands"]["command"]
+            assert "dmlc_core_tpu.tracker.launcher" in cmd
+            assert "python train.py" in cmd
+            assert f"DMLC_TASK_ID='{i}'" in cmd
+            assert "DMLC_ROLE='worker'" in cmd
     finally:
         rm.stop()
+
+
+def _fast_supervise(orig):
+    def fast(cluster, num_workers, num_servers, poll_interval=2.0, **kw):
+        return orig(cluster, num_workers, num_servers, poll_interval=0.01)
+
+    return fast

@@ -1,0 +1,426 @@
+"""AM-equivalent container supervision: retry, blacklist, abort semantics.
+
+Mirrors the reference ApplicationMaster's behavior
+(tracker/yarn/src/main/java/org/apache/hadoop/yarn/dmlc/
+ApplicationMaster.java:74,112,478-613) against a fake cluster, then drives
+the REST adapter end-to-end against a stateful mock ResourceManager.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dmlc_core_tpu.tracker.yarn_supervisor import (EXIT_KILLED_PMEM,
+                                                   ClusterBackend, Container,
+                                                   ContainerSupervisor,
+                                                   JobAbort)
+
+
+class FakeCluster(ClusterBackend):
+    """Records every call; hands out containers on request via offer()."""
+
+    def __init__(self):
+        self.requests = []      # TaskRecords asked for
+        self.launched = []      # (container, task)
+        self.burned = []
+        self.released = []
+        self.stopped = []
+        self._serial = 0
+
+    def request_containers(self, tasks):
+        self.requests.extend(tasks)
+
+    def launch(self, container, task):
+        self.launched.append((container, task))
+
+    def burn(self, container):
+        self.burned.append(container)
+
+    def release(self, container):
+        self.released.append(container)
+
+    def stop(self, container):
+        self.stopped.append(container)
+
+    def offer(self, sup, node):
+        """RM offers one container on `node` (onContainersAllocated)."""
+        self._serial += 1
+        c = Container(f"c{self._serial}", node)
+        sup.on_containers_allocated([c])
+        return c
+
+
+def test_two_failures_on_bad_node_retry_elsewhere_and_blacklist():
+    """VERDICT item 3's done-criterion: 2 container failures on one node ->
+    retries land on a different node, bad node blacklisted."""
+    fc = FakeCluster()
+    sup = ContainerSupervisor(fc, num_workers=2, max_attempts=3)
+    sup.start()
+    assert len(fc.requests) == 2
+
+    # both tasks land on badnode; both fail
+    c1 = fc.offer(sup, "badnode")
+    c2 = fc.offer(sup, "badnode")
+    assert [t.task_id for _, t in fc.launched] == [0, 1]
+    sup.on_container_completed(c1.container_id, 1, "exit 1")
+    assert "badnode" in sup.blacklist
+    sup.on_container_completed(c2.container_id, 1, "exit 1")
+
+    # failed tasks were re-requested (attempt 2)
+    assert len(fc.requests) == 4
+    assert fc.stopped == [c1, c2]
+
+    # the RM offers badnode again: the supervisor burns it, no launch
+    burned = fc.offer(sup, "badnode")
+    assert fc.burned == [burned]
+    assert len(fc.launched) == 2    # unchanged
+
+    # offers on a good node run the retries to completion
+    c3 = fc.offer(sup, "goodnode")
+    c4 = fc.offer(sup, "goodnode")
+    assert {t.task_id for _, t in fc.launched[2:]} == {0, 1}
+    assert all(c.node == "goodnode" for c, _ in fc.launched[2:])
+    sup.on_container_completed(c3.container_id, 0)
+    sup.on_container_completed(c4.container_id, 0)
+    assert sup.done
+    assert [t.attempts for t in sup.tasks] == [1, 1]
+
+
+def test_attempt_exhaustion_aborts_job():
+    fc = FakeCluster()
+    sup = ContainerSupervisor(fc, num_workers=1, max_attempts=3)
+    sup.start()
+    for i in range(2):
+        c = fc.offer(sup, f"node{i}")
+        sup.on_container_completed(c.container_id, 1)
+    c = fc.offer(sup, "node3")
+    with pytest.raises(JobAbort, match="failed more than 3"):
+        sup.on_container_completed(c.container_id, 1)
+    assert sup.aborted is not None
+    assert not sup.done
+
+
+def test_memory_kill_aborts_immediately():
+    """KILLED_EXCEEDED_PMEM aborts without retry
+    (ApplicationMaster.java:585-592)."""
+    fc = FakeCluster()
+    sup = ContainerSupervisor(fc, num_workers=2, max_attempts=3)
+    sup.start()
+    c1 = fc.offer(sup, "a")
+    c2 = fc.offer(sup, "b")
+    with pytest.raises(JobAbort, match="physical memory"):
+        sup.on_container_completed(c1.container_id, EXIT_KILLED_PMEM)
+    # the other running container was stopped, not retried
+    assert c2 in fc.stopped
+    assert len(fc.requests) == 2
+
+
+def test_surplus_containers_released():
+    fc = FakeCluster()
+    sup = ContainerSupervisor(fc, num_workers=1, max_attempts=3)
+    sup.start()
+    fc.offer(sup, "a")
+    surplus = fc.offer(sup, "b")
+    assert fc.released == [surplus]
+
+
+def test_launch_error_counts_as_failure():
+    fc = FakeCluster()
+    sup = ContainerSupervisor(fc, num_workers=1, max_attempts=3)
+    sup.start()
+    c = fc.offer(sup, "flaky")
+    sup.on_container_error(c.container_id, "NM start failed")
+    assert "flaky" in sup.blacklist
+    assert len(fc.requests) == 2
+
+
+def test_max_attempts_from_env(monkeypatch):
+    monkeypatch.setenv("DMLC_MAX_ATTEMPT", "5")
+    sup = ContainerSupervisor(FakeCluster(), num_workers=1)
+    assert sup.max_attempts == 5
+
+
+class StatefulMockRM:
+    """Mock RM REST server: apps transition NEW -> RUNNING(node) -> terminal.
+
+    The test script assigns each submitted app a node and an exit status.
+    """
+
+    def __init__(self, node_plan, fail_plan):
+        # node_plan: list of nodes assigned to apps in submission order
+        # fail_plan: set of app ordinals (0-based) that fail
+        self.node_plan = node_plan
+        self.fail_plan = fail_plan
+        self.apps = {}          # app_id -> dict(state/node/ordinal)
+        self.submissions = []
+        self.kills = []
+        self.diagnostics = "boom"   # reported for failing apps
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def start(self):
+        store = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _reply(self, status, obj):
+                out = json.dumps(obj).encode() if obj is not None else b""
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                with store._lock:
+                    if self.path.endswith("new-application"):
+                        app_id = f"app_{store._n}"
+                        store._n += 1
+                        self._reply(200, {"application-id": app_id})
+                        return
+                    if self.path.endswith("/apps"):
+                        sub = json.loads(body)
+                        app_id = sub["application-id"]
+                        ordinal = len(store.submissions)
+                        store.submissions.append(sub)
+                        node = store.node_plan[
+                            min(ordinal, len(store.node_plan) - 1)]
+                        store.apps[app_id] = {
+                            "ordinal": ordinal, "node": node,
+                            "polls": 0,
+                            "fails": ordinal in store.fail_plan,
+                        }
+                        self._reply(202, None)
+                        return
+                self._reply(404, None)
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                with store._lock:
+                    if self.path.endswith("/state"):
+                        app_id = self.path.split("/")[-2]
+                        store.kills.append(app_id)
+                        if app_id in store.apps:
+                            store.apps[app_id]["killed"] = True
+                        self._reply(200, None)
+                        return
+                self._reply(404, None)
+
+            def do_GET(self):
+                with store._lock:
+                    app_id = self.path.rsplit("/", 1)[-1]
+                    app = store.apps.get(app_id)
+                    if app is None:
+                        self._reply(404, None)
+                        return
+                    app["polls"] += 1
+                    if app.get("killed"):
+                        state, final = "KILLED", "KILLED"
+                    elif app["polls"] <= 1:
+                        state, final = "RUNNING", "UNDEFINED"
+                    elif app["fails"]:
+                        state, final = "FAILED", "FAILED"
+                    else:
+                        state, final = "FINISHED", "SUCCEEDED"
+                    self._reply(200, {"app": {
+                        "state": state, "finalStatus": final,
+                        "amHostHttpAddress": f"{app['node']}:8042",
+                        "diagnostics":
+                            store.diagnostics if app["fails"] else "",
+                    }})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def _yarn_opts(n=2):
+    from dmlc_core_tpu.tracker.opts import get_opts
+
+    return get_opts(["--cluster", "yarn", "--num-workers", str(n),
+                     "--worker-memory", "1g", "--jobname", "sup-job", "--",
+                     "python", "train.py"])
+
+
+def test_rest_supervision_retries_failed_app_off_blacklisted_node():
+    """End-to-end over REST: app 0 fails on node-a -> node-a blacklisted,
+    replacement app runs on node-b and the job completes."""
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster, supervise
+
+    # submission order: task0 -> node-a (fails), task1 -> node-b (ok),
+    # task0-retry -> node-b (ok)
+    rm = StatefulMockRM(node_plan=["node-a", "node-b", "node-b"],
+                        fail_plan={0}).start()
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(),
+                                  {"DMLC_NUM_WORKER": "2"})
+        sup = supervise(cluster, num_workers=2, num_servers=0,
+                        poll_interval=0.01)
+        assert sup.done
+        assert "node-a" in sup.blacklist
+        assert sup.tasks[0].attempts == 1
+        assert len(rm.submissions) == 3
+        # the retry resubmission carries the bumped DMLC_NUM_ATTEMPT
+        retry_cmd = rm.submissions[2]["am-container-spec"]["commands"]["command"]
+        assert "DMLC_NUM_ATTEMPT='1'" in retry_cmd
+        assert rm.submissions[2]["max-app-attempts"] == 1
+    finally:
+        rm.stop()
+
+
+def test_rest_supervision_burns_placement_on_blacklisted_node():
+    """A replacement app that lands on the blacklisted node is killed and
+    resubmitted (the REST recast of launchDummyTask)."""
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster, supervise
+
+    # task0 fails on node-a; retry lands on node-a again (burned), then node-b
+    rm = StatefulMockRM(node_plan=["node-a", "node-a", "node-b"],
+                        fail_plan={0}).start()
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(1),
+                                  {})
+        sup = supervise(cluster, num_workers=1, num_servers=0,
+                        poll_interval=0.01)
+        assert sup.done
+        assert len(rm.submissions) == 3
+        # app_0: stop of the failed container (nmClient.stopContainerAsync
+        # analog); app_1: the burned placement on the blacklisted node
+        assert rm.kills == ["app_0", "app_1"]
+        assert sup.tasks[0].attempts == 1
+    finally:
+        rm.stop()
+
+
+def test_rest_supervision_aborts_after_max_attempts():
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster, supervise
+
+    rm = StatefulMockRM(node_plan=["n0", "n1", "n2"],
+                        fail_plan={0, 1, 2}).start()
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(1),
+                                  {})
+        with pytest.raises(JobAbort, match="failed more than 3"):
+            supervise(cluster, num_workers=1, num_servers=0,
+                      poll_interval=0.01)
+    finally:
+        rm.stop()
+
+
+def test_task_bound_containers_no_misattribution():
+    """Out-of-order allocation reports must bind to the pre-assigned task
+    (REST apps bake DMLC_TASK_ID into the command at submit time)."""
+    from dmlc_core_tpu.tracker.yarn_supervisor import Container
+
+    fc = FakeCluster()
+    sup = ContainerSupervisor(fc, num_workers=2, max_attempts=3)
+    sup.start()
+    # task 1's app reports first
+    sup.on_containers_allocated([Container("app_1", "n1", task_id=1)])
+    sup.on_containers_allocated([Container("app_0", "n0", task_id=0)])
+    assert [t.task_id for _, t in fc.launched] == [1, 0]
+    # app_1 fails: task 1 (not task 0) is retried
+    sup.on_container_completed("app_1", 1)
+    assert fc.requests[-1].task_id == 1
+    assert sup.tasks[1].attempts == 1
+    assert sup.tasks[0].attempts == 0
+
+
+def test_rest_terminal_before_node_report_retries():
+    """An app that fails before ever reporting a node (AM launch failure)
+    must still bump the task's attempt and retry, not hang supervise()."""
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster, supervise
+
+    rm = StatefulMockRM(node_plan=["", "node-b"], fail_plan={0}).start()
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(1),
+                                  {})
+        # make app 0 fail immediately, with no RUNNING phase and no node
+        sup = supervise(cluster, num_workers=1, num_servers=0,
+                        poll_interval=0.01)
+        assert sup.done
+        assert sup.tasks[0].attempts == 1
+        assert len(rm.submissions) == 2
+        # no node was ever known for the failure; nothing blacklisted
+        assert "" not in sup.blacklist
+    finally:
+        rm.stop()
+
+
+def test_rest_memory_kill_diagnostics_abort():
+    """NM memory-kill diagnostics map to the AM's immediate-abort path."""
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster, supervise
+
+    rm = StatefulMockRM(node_plan=["node-a"], fail_plan={0}).start()
+    rm.diagnostics = ("Container killed: is running beyond physical memory "
+                      "limits. Killing container.")
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(1),
+                                  {})
+        with pytest.raises(JobAbort, match="physical memory"):
+            supervise(cluster, num_workers=1, num_servers=0,
+                      poll_interval=0.01)
+    finally:
+        rm.stop()
+
+
+def test_rest_abort_kills_pending_apps():
+    """JobAbort must not leak still-live applications of pending tasks."""
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster
+    from dmlc_core_tpu.tracker.yarn_supervisor import Container
+
+    rm = StatefulMockRM(node_plan=["n0", "n1"], fail_plan=set()).start()
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(2),
+                                  {})
+        sup = ContainerSupervisor(cluster, num_workers=2, max_attempts=1)
+        sup.start()          # both apps submitted and live
+        # task 0 starts and fails its only attempt -> abort; task 1 is still
+        # pending with a live app that must be killed
+        sup.on_containers_allocated([Container("app_0", "n0", task_id=0)])
+        with pytest.raises(JobAbort):
+            sup.on_container_completed("app_0", 1)
+        assert "app_1" in rm.kills
+        assert cluster.live == []
+    finally:
+        rm.stop()
+
+
+def test_rest_persistent_poll_errors_mark_container_lost(monkeypatch):
+    """An app the RM can no longer report on (404s) counts as a failure
+    after MAX_POLL_ERRORS sweeps instead of crashing the loop."""
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster, supervise
+
+    rm = StatefulMockRM(node_plan=["n0", "n1"], fail_plan=set()).start()
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(1),
+                                  {})
+        # the RM "forgets" app_0: every GET for it 404s
+        orig_apps = rm.apps
+
+        class Forgetful(dict):
+            def get(self, key, default=None):
+                if key == "app_0":
+                    return None
+                return orig_apps.__class__.get(self, key, default)
+
+        rm.apps = Forgetful(orig_apps)
+        sup = supervise(cluster, num_workers=1, num_servers=0,
+                        poll_interval=0.01)
+        # retry app (app_1) succeeded; the lost one burned one attempt
+        assert sup.done
+        assert sup.tasks[0].attempts == 1
+    finally:
+        rm.stop()
