@@ -412,6 +412,35 @@ class LineSplitter(InputSplitBase):
         return _next_line_record(cursor)
 
 
+def _next_recordio_record(cursor: ChunkCursor) -> Optional[memoryview]:
+    """Advance a cursor over a chunk of RecordIO frames, reassembling escaped
+    (multi-part) records (reference recordio.cc NextRecord)."""
+    if cursor.exhausted():
+        return None
+    data = cursor.data
+    CHECK(cursor.pos + 8 <= len(data), "invalid RecordIO format")
+    magic, lrec = struct.unpack_from("<II", data, cursor.pos)
+    CHECK_EQ(magic, rio.RECORDIO_MAGIC, "invalid RecordIO format")
+    cflag, clen = rio.decode_flag(lrec), rio.decode_length(lrec)
+    start = cursor.pos + 8
+    cursor.pos = start + (((clen + 3) >> 2) << 2)
+    CHECK(cursor.pos <= len(data), "invalid RecordIO format")
+    if cflag == 0:
+        return memoryview(data)[start:start + clen]
+    CHECK_EQ(cflag, 1, "invalid RecordIO format")
+    parts = [bytes(memoryview(data)[start:start + clen])]
+    while cflag != 3:
+        CHECK(cursor.pos + 8 <= len(data), "invalid RecordIO format")
+        magic, lrec = struct.unpack_from("<II", data, cursor.pos)
+        CHECK_EQ(magic, rio.RECORDIO_MAGIC, "invalid RecordIO format")
+        cflag, clen = rio.decode_flag(lrec), rio.decode_length(lrec)
+        start = cursor.pos + 8
+        parts.append(rio._MAGIC_BYTES)
+        parts.append(bytes(memoryview(data)[start:start + clen]))
+        cursor.pos = start + (((clen + 3) >> 2) << 2)
+    return memoryview(b"".join(parts))
+
+
 class RecordIOSplitter(InputSplitBase):
     """Record = magic-framed RecordIO blob (reference src/io/recordio_split.cc)."""
 
@@ -462,30 +491,7 @@ class RecordIOSplitter(InputSplitBase):
         return int(cand[-1]) * 4 if cand.size else 0
 
     def extract_next_record(self, cursor: ChunkCursor) -> Optional[memoryview]:
-        if cursor.exhausted():
-            return None
-        data = cursor.data
-        CHECK(cursor.pos + 8 <= len(data), "invalid RecordIO format")
-        magic, lrec = struct.unpack_from("<II", data, cursor.pos)
-        CHECK_EQ(magic, rio.RECORDIO_MAGIC, "invalid RecordIO format")
-        cflag, clen = rio.decode_flag(lrec), rio.decode_length(lrec)
-        start = cursor.pos + 8
-        cursor.pos = start + (((clen + 3) >> 2) << 2)
-        CHECK(cursor.pos <= len(data), "invalid RecordIO format")
-        if cflag == 0:
-            return memoryview(data)[start:start + clen]
-        CHECK_EQ(cflag, 1, "invalid RecordIO format")
-        parts = [bytes(memoryview(data)[start:start + clen])]
-        while cflag != 3:
-            CHECK(cursor.pos + 8 <= len(data), "invalid RecordIO format")
-            magic, lrec = struct.unpack_from("<II", data, cursor.pos)
-            CHECK_EQ(magic, rio.RECORDIO_MAGIC, "invalid RecordIO format")
-            cflag, clen = rio.decode_flag(lrec), rio.decode_length(lrec)
-            start = cursor.pos + 8
-            parts.append(rio._MAGIC_BYTES)
-            parts.append(bytes(memoryview(data)[start:start + clen]))
-            cursor.pos = start + (((clen + 3) >> 2) << 2)
-        return memoryview(b"".join(parts))
+        return _next_recordio_record(cursor)
 
 
 class IndexedRecordIOSplitter(RecordIOSplitter):
@@ -508,6 +514,16 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._index_begin = 0
         self._index_end = 0
         self._n_overflow = 0
+        # native span reader: index policy (partitioning, shuffle) stays
+        # here; the byte-moving + read-ahead runs in C++ when available.
+        # _native_unavailable is permanent (remote fs / no library);
+        # _native_disabled is epoch-scoped (batch size changed mid-plan) and
+        # cleared by the next before_first, which builds a fresh plan anyway
+        self._span_reader = None
+        self._native_unavailable = False
+        self._native_disabled = False
+        self._plan_batch = batch_size
+        self._popped = 0
         self.reset_partition(part_index, num_parts)
 
     def _read_index_file(self, index_uri: str) -> None:
@@ -527,8 +543,18 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         ntotal = len(self._index)
         nstep = (ntotal + num_parts - 1) // num_parts
         if part_index * nstep >= ntotal:
+            # empty partition: clear ALL cursor state (a previous partition's
+            # index window / open stream / native plan must not replay)
             self._offset_begin = self._offset_end = 0
+            self._index_begin = self._index_end = 0
+            self._current_index = 0
+            self._n_overflow = 0
+            self._permutation = []
             self._cursor = ChunkCursor()
+            self._close_fs()
+            if self._span_reader is not None:
+                self._span_reader.set_plan([], [], [])
+                self._popped = 0
             return
         self._index_begin = part_index * nstep
         self._offset_begin = self._index[self._index_begin][0]
@@ -553,8 +579,79 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self._current_index = 0
         else:
             self._current_index = self._index_begin
+        self._n_overflow = 0
         if self._offset_begin < self._offset_end:
             InputSplitBase.before_first(self)
+        self._native_disabled = False   # a new epoch gets a fresh plan
+        reader = self._native_reader()
+        if reader is not None:
+            offs, szs, counts = self._epoch_plan()
+            reader.set_plan(offs, szs, counts)
+            self._plan_batch = self._batch_size
+            self._popped = 0
+
+    # -- native span fast path ----------------------------------------------
+    def _native_reader(self):
+        """The C++ span reader, created on first use (local files only)."""
+        if self._native_unavailable or self._native_disabled:
+            return None
+        if self._span_reader is None:
+            if not isinstance(self._filesys, fsys.LocalFileSystem):
+                self._native_unavailable = True
+                return None
+            from dmlc_core_tpu import native_bridge
+
+            if not native_bridge.lsplit_available():
+                self._native_unavailable = True
+                return None
+            self._span_reader = native_bridge.NativeSpanReader(
+                [info.path.name for info in self._files],
+                [info.size for info in self._files])
+        return self._span_reader
+
+    def _epoch_plan(self):
+        """(offsets, sizes, batch counts) for one epoch of batch reads."""
+        offs: List[int] = []
+        szs: List[int] = []
+        counts: List[int] = []
+        bs = self._batch_size
+        if self._offset_begin >= self._offset_end:
+            return offs, szs, counts
+        if self._shuffle:
+            for j0 in range(0, len(self._permutation), bs):
+                group = self._permutation[j0:j0 + bs]
+                for j in group:
+                    off, size = self._index[j]
+                    offs.append(off)
+                    szs.append(size)
+                counts.append(len(group))
+        else:
+            i = self._index_begin
+            while i < self._index_end:
+                last = min(i + bs, self._index_end)
+                begin_off = self._index[i][0]
+                end_off = (self._offset_end if last == self._index_end
+                           else self._index[last][0])
+                offs.append(begin_off)
+                szs.append(end_off - begin_off)
+                counts.append(1)
+                i = last
+        return offs, szs, counts
+
+    def _resync_from_native(self) -> None:
+        """Abandon the native plan (batch size changed mid-epoch): restore
+        the Python cursor from the number of batches already delivered."""
+        consumed = self._popped * self._plan_batch
+        if self._shuffle:
+            self._current_index = min(consumed, len(self._permutation))
+        else:
+            self._current_index = min(self._index_begin + consumed,
+                                      self._index_end)
+        self._n_overflow = 0
+        self._native_disabled = True
+        if self._span_reader is not None:
+            self._span_reader.close()
+            self._span_reader = None
 
     def _index_offset_end(self, idx: int) -> int:
         if idx < len(self._index):
@@ -580,6 +677,14 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
 
     def next_batch_bytes(self, n_records: int) -> Optional[bytes]:
         """Read the next `n_records` batch as one chunk (reference NextBatchEx)."""
+        if (self._span_reader is not None and not self._native_disabled
+                and not self._native_unavailable):
+            if n_records == self._plan_batch and not self._n_overflow:
+                chunk = self._span_reader.next_chunk()
+                if chunk is not None:
+                    self._popped += 1
+                return chunk
+            self._resync_from_native()
         if self._shuffle:
             n = self._n_overflow if self._n_overflow else n_records
             parts: List[bytes] = []
@@ -619,6 +724,12 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
 
     def set_random_seed(self, seed: int) -> None:
         self._rng = random.Random(self.KRAND_MAGIC + seed)
+
+    def close(self) -> None:
+        if self._span_reader is not None:
+            self._span_reader.close()
+            self._span_reader = None
+        InputSplitBase.close(self)
 
 
 class SingleFileSplit(InputSplit):
@@ -931,17 +1042,18 @@ class InputSplitShuffle(InputSplit):
 
 
 class NativeLineSplitter(InputSplit):
-    """C++ line-split engine with built-in prefetch (native/input_split.cc).
+    """C++ split engine with built-in prefetch (native/input_split.cc).
 
-    Drop-in for ``ThreadedInputSplit(LineSplitter(...))`` over local files:
-    the chunk sharding/realignment loop AND the double-buffered read-ahead
-    run natively (reference src/io/input_split_base.cc +
+    Drop-in for ``ThreadedInputSplit(LineSplitter(...))`` (or the RecordIO
+    equivalent, ``format="recordio"``) over local files: the chunk
+    sharding/realignment loop AND the double-buffered read-ahead run natively
+    (reference src/io/input_split_base.cc + line_split.cc/recordio_split.cc +
     threaded_input_split.h in one).  Selected by the factory when every
     expanded file is local and the native core is built.
     """
 
     def __init__(self, fs: fsys.FileSystem, uri: str, part_index: int,
-                 num_parts: int):
+                 num_parts: int, format: str = "line"):
         from dmlc_core_tpu import native_bridge
 
         # the Python engine's expansion (';'-lists, regex globs, directory
@@ -949,11 +1061,17 @@ class NativeLineSplitter(InputSplit):
         files = _expand_input_files(fs, uri)
         self._paths = [info.path.name for info in files]
         self._sizes = [info.size for info in files]
+        if format == "recordio":
+            for info in files:
+                CHECK_EQ(info.size % 4, 0,
+                         f"file {info.path.str()} does not align by 4 bytes")
+        self._extract = (_next_recordio_record if format == "recordio"
+                         else _next_line_record)
         self._part, self._nparts = part_index, num_parts
         self._buffer_size = DEFAULT_BUFFER_SIZE
         self._native = native_bridge.NativeLineSplit(
             self._paths, self._sizes, part_index, num_parts,
-            buffer_size=self._buffer_size)
+            buffer_size=self._buffer_size, format=format)
         self._cursor = ChunkCursor()
 
     def before_first(self) -> None:
@@ -976,7 +1094,7 @@ class NativeLineSplitter(InputSplit):
 
     def next_record(self) -> Optional[memoryview]:
         return _next_record_from_chunks(self, self._native.next_chunk,
-                                        _next_line_record)
+                                        self._extract)
 
     def get_total_size(self) -> int:
         return self._native.total_size()
@@ -1008,15 +1126,22 @@ def create_input_split(
     CHECK_LT(part_index, num_parts, "invalid input parameters for create_input_split")
     path = fsys.URI(spec.uri)
     fs = fsys.get_filesystem(path)
-    if type == "text":
-        if (threaded and not spec.cache_file
+    def native_ok() -> bool:
+        if not (threaded and not spec.cache_file
                 and isinstance(fs, fsys.LocalFileSystem)):
-            from dmlc_core_tpu import native_bridge
+            return False
+        from dmlc_core_tpu import native_bridge
 
-            if native_bridge.lsplit_available():
-                return NativeLineSplitter(fs, spec.uri, part_index, num_parts)
+        return native_bridge.lsplit_available()
+
+    if type == "text":
+        if native_ok():
+            return NativeLineSplitter(fs, spec.uri, part_index, num_parts)
         split: InputSplitBase = LineSplitter(fs, spec.uri, part_index, num_parts)
     elif type == "recordio":
+        if native_ok():
+            return NativeLineSplitter(fs, spec.uri, part_index, num_parts,
+                                      format="recordio")
         split = RecordIOSplitter(fs, spec.uri, part_index, num_parts)
     elif type == "indexed_recordio":
         CHECK(index_uri is not None, "need an index file to use indexed_recordio")

@@ -261,14 +261,29 @@ def _load_lsplit():
     lib = _load()
     if lib is None:
         return None
-    if not hasattr(lib, "dmlc_tpu_lsplit_open"):
-        return None  # stale library built before input_split.cc existed
+    if not hasattr(lib, "dmlc_tpu_span_open"):
+        return None  # stale library built before the full split engine existed
     if not getattr(lib, "_lsplit_wired", False):
-        lib.dmlc_tpu_lsplit_open.restype = ctypes.c_void_p
-        lib.dmlc_tpu_lsplit_open.argtypes = [
+        open_sig = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+        lib.dmlc_tpu_lsplit_open.restype = ctypes.c_void_p
+        lib.dmlc_tpu_lsplit_open.argtypes = open_sig
+        lib.dmlc_tpu_rsplit_open.restype = ctypes.c_void_p
+        lib.dmlc_tpu_rsplit_open.argtypes = open_sig
+        lib.dmlc_tpu_span_open.restype = ctypes.c_void_p
+        lib.dmlc_tpu_span_open.argtypes = open_sig[:4]
+        lib.dmlc_tpu_span_set_plan.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64]
+        lib.dmlc_tpu_span_next_chunk.restype = ctypes.c_int64
+        lib.dmlc_tpu_span_next_chunk.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+        lib.dmlc_tpu_span_error.restype = ctypes.c_char_p
+        lib.dmlc_tpu_span_error.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_span_close.argtypes = [ctypes.c_void_p]
         lib.dmlc_tpu_lsplit_hint.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.dmlc_tpu_lsplit_total.restype = ctypes.c_int64
         lib.dmlc_tpu_lsplit_total.argtypes = [ctypes.c_void_p]
@@ -288,24 +303,32 @@ def lsplit_available() -> bool:
     return _load_lsplit() is not None
 
 
-class NativeLineSplit:
-    """Handle over the C++ line-split engine (sharded read + prefetch thread).
+def _encode_files(paths, sizes):
+    encoded = [p.encode() for p in paths]
+    blob = b"".join(encoded)         # length-delimited: any filename byte ok
+    lens = (ctypes.c_int64 * len(encoded))(*[len(e) for e in encoded])
+    arr = (ctypes.c_int64 * len(sizes))(*sizes)
+    return blob, lens, arr
 
-    ``next_chunk`` returns bytes of whole line records for the partition, or
+
+class NativeLineSplit:
+    """Handle over the C++ split engine (sharded read + prefetch thread).
+
+    ``next_chunk`` returns bytes of whole records for the partition, or
     None at the end.  ``reset`` re-partitions (or rewinds, with the same
-    arguments).
+    arguments).  ``format`` selects the record kind: "line" or "recordio"
+    (same engine, different realignment scan — native/input_split.cc).
     """
 
     def __init__(self, paths, sizes, part: int, nparts: int,
-                 buffer_size: int = 8 << 20):
+                 buffer_size: int = 8 << 20, format: str = "line"):
         lib = _load_lsplit()
         assert lib is not None
         self._lib = lib
-        encoded = [p.encode() for p in paths]
-        blob = b"".join(encoded)     # length-delimited: any filename byte ok
-        lens = (ctypes.c_int64 * len(encoded))(*[len(e) for e in encoded])
-        arr = (ctypes.c_int64 * len(sizes))(*sizes)
-        self._handle = lib.dmlc_tpu_lsplit_open(
+        blob, lens, arr = _encode_files(paths, sizes)
+        open_fn = (lib.dmlc_tpu_rsplit_open if format == "recordio"
+                   else lib.dmlc_tpu_lsplit_open)
+        self._handle = open_fn(
             blob, lens, arr, len(sizes), part, nparts, buffer_size)
         self._check()
 
@@ -343,6 +366,68 @@ class NativeLineSplit:
     def close(self) -> None:
         if self._handle is not None:
             self._lib.dmlc_tpu_lsplit_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeSpanReader:
+    """C++ span-plan reader: index-driven batch reads with prefetch.
+
+    The caller (IndexedRecordIOSplitter) computes a per-epoch plan — flat
+    (offset, size) spans in the concatenated-file space plus per-batch span
+    counts — and pops concatenated batch chunks; a native producer thread
+    reads ahead (native/input_split.cc SpanReadEngine).
+    """
+
+    def __init__(self, paths, sizes):
+        lib = _load_lsplit()
+        assert lib is not None
+        self._lib = lib
+        blob, lens, arr = _encode_files(paths, sizes)
+        self._handle = lib.dmlc_tpu_span_open(blob, lens, arr, len(sizes))
+
+    def _require_open(self):
+        if self._handle is None:
+            raise ValueError("NativeSpanReader is closed")
+        return self._handle
+
+    def _check(self):
+        err = self._lib.dmlc_tpu_span_error(self._require_open())
+        if err:
+            raise OSError(err.decode())
+
+    def set_plan(self, offsets, sizes, counts) -> None:
+        """Start a new epoch: spans (offsets[i], sizes[i]); batch b is the
+        concatenation of counts[b] consecutive spans."""
+        offs = np.ascontiguousarray(offsets, dtype=np.int64)
+        szs = np.ascontiguousarray(sizes, dtype=np.int64)
+        cnt = np.ascontiguousarray(counts, dtype=np.int64)
+        assert len(offs) == len(szs)
+        self._lib.dmlc_tpu_span_set_plan(
+            self._require_open(),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            szs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cnt.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(offs), len(cnt))
+
+    def next_chunk(self):
+        ptr = ctypes.c_char_p()
+        n = self._lib.dmlc_tpu_span_next_chunk(self._require_open(),
+                                               ctypes.byref(ptr))
+        if n < 0:
+            self._check()
+        if n <= 0:
+            return None
+        return ctypes.string_at(ptr, n)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dmlc_tpu_span_close(self._handle)
             self._handle = None
 
     def __del__(self):

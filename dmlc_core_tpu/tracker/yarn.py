@@ -96,11 +96,12 @@ class RestYarnCluster(ClusterBackend):
         self.reported: Dict[str, str] = {}          # app_id -> node reported
         self.live: List[str] = []                   # app ids worth polling
         self.poll_errors: Dict[str, int] = {}       # app_id -> consecutive
+        self.submit_backlog: List[TaskRecord] = []  # deferred (RM was down)
 
     # -- ClusterBackend ------------------------------------------------------
     def request_containers(self, tasks: List[TaskRecord]) -> None:
         for task in tasks:
-            self._submit_app(task)
+            self._try_submit_app(task)
 
     def launch(self, container: Container, task: TaskRecord) -> None:
         # the app's AM container already runs the task command; allocation
@@ -114,7 +115,7 @@ class RestYarnCluster(ClusterBackend):
         task = self.app_task.get(container.container_id)
         self._kill_app(container.container_id)
         if task is not None:
-            self._submit_app(task)
+            self._try_submit_app(task)
 
     def release(self, container: Container) -> None:
         self._kill_app(container.container_id)
@@ -131,6 +132,20 @@ class RestYarnCluster(ClusterBackend):
                 self._kill_app(app_id)
 
     # -- REST plumbing -------------------------------------------------------
+    def _try_submit_app(self, task: TaskRecord) -> None:
+        """Submit, deferring to the next poll sweep when the RM is down.
+
+        A (re)submission raced against an RM outage must not crash the
+        supervision loop — the task stays pending in the supervisor, and the
+        backlog retries once per sweep until the RM answers.
+        """
+        try:
+            self._submit_app(task)
+        except OSError as exc:   # URLError/HTTPError are OSError subclasses
+            logger.warning("submit of task %d failed (%s); will retry",
+                           task.task_id, exc)
+            self.submit_backlog.append(task)
+
     def _submit_app(self, task: TaskRecord) -> None:
         status, new_app = _rest(self.rm_uri,
                                 "/ws/v1/cluster/apps/new-application",
@@ -184,6 +199,9 @@ class RestYarnCluster(ClusterBackend):
 
     def poll(self, sup: ContainerSupervisor) -> None:
         """One monitoring sweep: translate app states to supervisor events."""
+        backlog, self.submit_backlog = self.submit_backlog, []
+        for task in backlog:
+            self._try_submit_app(task)
         for app_id in list(self.live):
             try:
                 _, body = _rest(self.rm_uri, f"/ws/v1/cluster/apps/{app_id}")
@@ -196,8 +214,13 @@ class RestYarnCluster(ClusterBackend):
                                self.MAX_POLL_ERRORS, exc)
                 if n >= self.MAX_POLL_ERRORS:
                     self.live.remove(app_id)
-                    self._ensure_reported(sup, app_id, "")
-                    sup.on_container_error(app_id, f"unpollable: {exc}")
+                    msg = f"unpollable: {exc}"
+                    if app_id in self.reported:
+                        sup.on_container_error(app_id, msg)
+                    else:
+                        self.reported[app_id] = ""
+                        sup.on_unreported_completion(
+                            self._container(app_id, ""), -1, msg)
                 continue
             self.poll_errors.pop(app_id, None)
             app = body.get("app", body)
@@ -213,26 +236,24 @@ class RestYarnCluster(ClusterBackend):
                 continue
             if terminal:
                 self.live.remove(app_id)
-                # an app that died before ever reporting a node (queue
-                # rejection, AM launch failure) still carries a task: emit
-                # the allocation first so the completion finds it running
-                self._ensure_reported(sup, app_id, node)
                 final = app.get("finalStatus", "")
                 ok = state == "FINISHED" and final == "SUCCEEDED"
                 diag = app.get("diagnostics", "")
-                sup.on_container_completed(
-                    app_id, 0 if ok else _exit_status_from_diag(diag),
-                    diagnostics=diag)
+                status = 0 if ok else _exit_status_from_diag(diag)
+                if app_id in self.reported:
+                    sup.on_container_completed(app_id, status,
+                                               diagnostics=diag)
+                else:
+                    # died (or finished) before ever reporting a node: no
+                    # allocation happened, so route around the blacklist/burn
+                    # logic and complete the task directly
+                    self.reported[app_id] = node
+                    sup.on_unreported_completion(
+                        self._container(app_id, node), status, diag)
 
     def _container(self, app_id: str, node: str) -> Container:
         task = self.app_task[app_id]
         return Container(app_id, node, task_id=task.task_id)
-
-    def _ensure_reported(self, sup: ContainerSupervisor, app_id: str,
-                         node: str) -> None:
-        if app_id not in self.reported:
-            self.reported[app_id] = node
-            sup.on_containers_allocated([self._container(app_id, node)])
 
 
 def supervise(cluster: RestYarnCluster, num_workers: int, num_servers: int,

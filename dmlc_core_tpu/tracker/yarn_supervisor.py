@@ -200,6 +200,24 @@ class ContainerSupervisor:
         logger.warning("container %s error: %s", container_id, error)
         self._handle_failure(container_id)
 
+    def on_unreported_completion(self, c: Container, exit_status: int,
+                                 diagnostics: str = "") -> None:
+        """Terminal event for a container that never reported a placement.
+
+        REST-model backends can see an app jump straight to FAILED/FINISHED
+        between polls (fast-failing command, queue rejection).  Routing that
+        through the allocation path would be wrong — a blacklisted node would
+        burn the already-dead container and swallow the completion — so the
+        task is matched and completed directly: successes count, failures
+        bump the attempt counter like any other.
+        """
+        task = self._match_pending(c)
+        if task is None:
+            return
+        task.container = c
+        self.running[c.container_id] = task
+        self.on_container_completed(c.container_id, exit_status, diagnostics)
+
     # -- internals -----------------------------------------------------------
     def _match_pending(self, c: Container) -> Optional[TaskRecord]:
         """The pending task this container serves: the pre-bound one when the

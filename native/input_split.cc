@@ -1,9 +1,11 @@
-// Native line-record input split: byte-range sharding over local files with
-// record realignment at shard edges and a double-buffered prefetch thread.
+// Native input split: byte-range sharding over local files with record
+// realignment at shard edges and a double-buffered prefetch thread.
 //
-// C++ counterpart of dmlc_core_tpu/io/input_split.py (LineSplitter +
-// ThreadedInputSplit) and of the reference engine it mirrors
-// (src/io/input_split_base.cc ResetPartition/ReadChunk, src/io/line_split.cc,
+// C++ counterpart of dmlc_core_tpu/io/input_split.py (LineSplitter,
+// RecordIOSplitter, IndexedRecordIOSplitter byte paths + ThreadedInputSplit)
+// and of the reference engines they mirror (src/io/input_split_base.cc
+// ResetPartition/ReadChunk, src/io/line_split.cc, src/io/recordio_split.cc
+// magic-resync, src/io/indexed_recordio_split.cc batch reads,
 // src/io/threaded_input_split.h).  The Python layer delegates here when every
 // file is local; remote URIs keep the Python path.  Semantics are kept
 // bit-identical to the Python engine — the all-parts coverage tests diff the
@@ -20,6 +22,7 @@
 #include <cstdio>
 #include <cstring>
 #include <deque>
+#include <functional>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -45,15 +48,89 @@ inline int Seek64(std::FILE *fp, int64_t off) {
 
 bool IsEol(unsigned char c) { return c == '\n' || c == '\r'; }
 
+// RecordIO framing constants (dmlc_core_tpu/io/recordio.py, reference
+// include/dmlc/recordio.h:45)
+constexpr uint32_t kRecordIOMagic = 0xced7230a;
+inline uint32_t CFlag(uint32_t len_word) { return (len_word >> 29) & 7u; }
+
+enum Format { kLine = 0, kRecordIO = 1 };
+
+// Shared double-buffered prefetch: one producer thread, queue capacity 2,
+// (ok, chunk) items with an end sentinel that stays queued for repeated
+// pops (reference threaded_input_split.h:23-101 / ThreadedIter cap-2).
+// Used by both split engines so the protocol can't drift between them.
+class PrefetchQueue {
+ public:
+  ~PrefetchQueue() { Stop(); }
+
+  // next(chunk) -> true while chunks remain; false terminates the producer
+  void Start(std::function<bool(std::vector<char> *)> next) {
+    stop_ = false;
+    producer_ = std::thread([this, next = std::move(next)] {
+      while (true) {
+        std::vector<char> chunk;
+        bool ok = next(&chunk);
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_space_.wait(lk, [this] { return queue_.size() < 2 || stop_; });
+        if (stop_) return;
+        queue_.emplace_back(ok, std::move(chunk));
+        cv_data_.notify_one();
+        if (!ok) return;  // end-of-data sentinel queued
+      }
+    });
+  }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+      cv_space_.notify_all();
+    }
+    if (producer_.joinable()) producer_.join();
+    producer_ = std::thread();
+    queue_.clear();
+  }
+
+  // end sentinel without a producer (empty partition/plan): Pop never blocks
+  void PushEnd() {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.emplace_back(false, std::vector<char>());
+    cv_data_.notify_all();
+  }
+
+  bool Pop(std::vector<char> *out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_data_.wait(lk, [this] { return !queue_.empty(); });
+    auto item = std::move(queue_.front());
+    queue_.pop_front();
+    cv_space_.notify_one();
+    if (!item.first) {
+      // leave the sentinel for repeated calls
+      queue_.emplace_front(false, std::vector<char>());
+      return false;
+    }
+    *out = std::move(item.second);
+    return true;
+  }
+
+ private:
+  std::thread producer_;
+  std::mutex mu_;
+  std::condition_variable cv_data_, cv_space_;
+  std::deque<std::pair<bool, std::vector<char>>> queue_;
+  bool stop_ = false;
+};
+
 class LineSplitEngine {
  public:
-  LineSplitEngine(std::vector<FileEnt> files, int64_t buffer_size)
-      : files_(std::move(files)), buffer_size_(buffer_size) {
+  LineSplitEngine(std::vector<FileEnt> files, int64_t buffer_size,
+                  Format format = kLine)
+      : files_(std::move(files)), buffer_size_(buffer_size), format_(format) {
     offsets_.push_back(0);
     for (auto &f : files_) offsets_.push_back(offsets_.back() + f.size);
   }
 
-  ~LineSplitEngine() { StopPrefetch(); CloseFile(); }
+  ~LineSplitEngine() { queue_.Stop(); CloseFile(); }
 
   int64_t TotalSize() const { return offsets_.back(); }
   std::string Error() const {
@@ -62,22 +139,22 @@ class LineSplitEngine {
   }
 
   void ResetPartition(int64_t part, int64_t nparts) {
-    StopPrefetch();
+    queue_.Stop();
     ClearError();  // a past transient failure must not poison future resets
     if (!DoResetPartition(part, nparts)) {
       // empty partition or failure: queue the end sentinel so PopChunk
       // never blocks waiting on a producer that was never started
-      std::lock_guard<std::mutex> lk(mu_);
-      queue_.emplace_back(false, std::vector<char>());
-      cv_data_.notify_all();
+      queue_.PushEnd();
       return;
     }
-    StartPrefetch();
+    queue_.Start([this](std::vector<char> *c) { return NextChunk(c); });
   }
 
   bool DoResetPartition(int64_t part, int64_t nparts) {
     int64_t ntotal = offsets_.back();
-    int64_t nstep = (ntotal + nparts - 1) / nparts;  // align=1 for lines
+    int64_t nstep = (ntotal + nparts - 1) / nparts;
+    int64_t align = format_ == kRecordIO ? 4 : 1;
+    nstep = (nstep + align - 1) / align * align;
     begin_ = std::min(nstep * part, ntotal);
     end_ = std::min(nstep * (part + 1), ntotal);
     overflow_.clear();
@@ -133,48 +210,8 @@ class LineSplitEngine {
     }
   }
 
-  // ---- prefetch thread (double buffering, queue capacity 2) --------------
-  void StartPrefetch() {
-    stop_ = false;
-    producer_ = std::thread([this] {
-      while (true) {
-        std::vector<char> chunk;
-        bool ok = NextChunk(&chunk);
-        std::unique_lock<std::mutex> lk(mu_);
-        cv_space_.wait(lk, [this] { return queue_.size() < 2 || stop_; });
-        if (stop_) return;
-        queue_.emplace_back(ok, std::move(chunk));
-        cv_data_.notify_one();
-        if (!ok) return;  // end-of-partition sentinel queued
-      }
-    });
-  }
-
-  void StopPrefetch() {
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      stop_ = true;
-      cv_space_.notify_all();
-    }
-    if (producer_.joinable()) producer_.join();
-    queue_.clear();
-  }
-
   // pops the next prefetched chunk; false at end
-  bool PopChunk(std::vector<char> *out) {
-    std::unique_lock<std::mutex> lk(mu_);
-    cv_data_.wait(lk, [this] { return !queue_.empty(); });
-    auto item = std::move(queue_.front());
-    queue_.pop_front();
-    cv_space_.notify_one();
-    if (!item.first) {
-      // leave the sentinel for repeated calls
-      queue_.emplace_front(false, std::vector<char>());
-      return false;
-    }
-    *out = std::move(item.second);
-    return true;
-  }
+  bool PopChunk(std::vector<char> *out) { return queue_.Pop(out); }
 
   // error_ is written by the prefetch thread (Fail in Read/OpenFile) and
   // read by the consumer thread — guard it with its own mutex so a torn
@@ -212,9 +249,14 @@ class LineSplitEngine {
     if (fp_) { std::fclose(fp_); fp_ = nullptr; }
   }
 
-  // bytes to skip from the current position to the next line head
-  // (reference line_split.cc:9-26: to first EOL, then past the EOL run)
+  // bytes to skip from the current position to the next record head
   int64_t SeekRecordBegin(std::FILE *fp) {
+    return format_ == kRecordIO ? SeekRecordBeginRecordIO(fp)
+                                : SeekRecordBeginLine(fp);
+  }
+
+  // (reference line_split.cc:9-26: to first EOL, then past the EOL run)
+  static int64_t SeekRecordBeginLine(std::FILE *fp) {
     int64_t nstep = 0;
     bool seen_eol = false;
     char block[4096];
@@ -235,8 +277,47 @@ class LineSplitEngine {
     }
   }
 
+  // word-scan for magic followed by cflag 0/1 (reference
+  // recordio_split.cc:9-26; mirrors RecordIOSplitter.seek_record_begin in
+  // io/input_split.py — incl. consuming the word after a failed flag test)
+  static int64_t SeekRecordBeginRecordIO(std::FILE *fp) {
+    int64_t nstep = 0;
+    bool saw_magic = false;
+    char block[4096];
+    while (true) {
+      size_t n = std::fread(block, 1, sizeof(block), fp);
+      size_t nwords = n / 4;
+      if (nwords == 0) return nstep;
+      for (size_t i = 0; i < nwords; ++i) {
+        uint32_t w;
+        std::memcpy(&w, block + i * 4, 4);
+        nstep += 4;
+        if (saw_magic) {
+          saw_magic = false;
+          uint32_t flag = CFlag(w);
+          if (flag == 0 || flag == 1) return nstep - 8;
+        } else if (w == kRecordIOMagic) {
+          saw_magic = true;
+        }
+      }
+      if (n != nwords * 4) return nstep;  // sub-word tail: end of data
+    }
+  }
+
   // offset of the last record head in [data, data+n) (0 if none beyond start)
-  static int64_t FindLastRecordBegin(const char *data, int64_t n) {
+  int64_t FindLastRecordBegin(const char *data, int64_t n) const {
+    if (format_ == kRecordIO) {
+      int64_t nwords = n / 4;
+      for (int64_t i = nwords - 2; i > 0; --i) {
+        uint32_t w, next;
+        std::memcpy(&w, data + i * 4, 4);
+        if (w != kRecordIOMagic) continue;
+        std::memcpy(&next, data + (i + 1) * 4, 4);
+        uint32_t flag = CFlag(next);
+        if (flag == 0 || flag == 1) return i * 4;
+      }
+      return 0;
+    }
     for (int64_t i = n - 1; i > 0; --i) {
       if (IsEol(static_cast<unsigned char>(data[i]))) return i + 1;
     }
@@ -290,18 +371,146 @@ class LineSplitEngine {
   std::vector<FileEnt> files_;
   std::vector<int64_t> offsets_;
   std::atomic<int64_t> buffer_size_;
+  Format format_;
   std::FILE *fp_ = nullptr;
   size_t file_ptr_ = 0;
   int64_t begin_ = 0, end_ = 0, curr_ = 0;
   std::vector<char> overflow_;
   mutable std::mutex err_mu_;
   std::string error_;
+  PrefetchQueue queue_;
+};
 
-  std::thread producer_;
-  std::mutex mu_;
-  std::condition_variable cv_data_, cv_space_;
-  std::deque<std::pair<bool, std::vector<char>>> queue_;
-  bool stop_ = false;
+// Index-driven batch reads with prefetch (reference
+// src/io/indexed_recordio_split.cc:43-227 byte path).  Policy — index
+// partitioning, batch grouping, the seeded shuffle permutation — stays in
+// Python (io/input_split.py IndexedRecordIOSplitter); this engine executes a
+// per-epoch *plan*: a flat list of (offset, size) spans in the concatenated
+// file space plus per-batch span counts, each batch concatenated into one
+// chunk and read ahead by a producer thread.
+class SpanReadEngine {
+ public:
+  explicit SpanReadEngine(std::vector<FileEnt> files)
+      : files_(std::move(files)) {
+    offsets_.push_back(0);
+    for (auto &f : files_) offsets_.push_back(offsets_.back() + f.size);
+  }
+
+  ~SpanReadEngine() { queue_.Stop(); CloseFile(); }
+
+  std::string Error() const {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    return error_;
+  }
+  bool failed() const {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    return !error_.empty();
+  }
+
+  void SetPlan(const int64_t *offs, const int64_t *sizes,
+               const int64_t *counts, int64_t nspans, int64_t nbatches) {
+    queue_.Stop();
+    {
+      std::lock_guard<std::mutex> lk(err_mu_);
+      error_.clear();
+    }
+    spans_.assign(nspans, {});
+    for (int64_t i = 0; i < nspans; ++i) spans_[i] = {offs[i], sizes[i]};
+    counts_.assign(counts, counts + nbatches);
+    next_batch_ = 0;
+    next_span_ = 0;
+    if (nbatches == 0) {
+      queue_.PushEnd();   // empty plan: Pop never blocks on a producer
+      return;
+    }
+    queue_.Start([this](std::vector<char> *c) { return NextBatch(c); });
+  }
+
+  bool PopChunk(std::vector<char> *out) { return queue_.Pop(out); }
+
+ private:
+  bool NextBatch(std::vector<char> *out) {
+    out->clear();
+    if (next_batch_ >= static_cast<int64_t>(counts_.size())) return false;
+    int64_t nspan = counts_[next_batch_++];
+    for (int64_t k = 0; k < nspan; ++k) {
+      if (next_span_ >= static_cast<int64_t>(spans_.size())) {
+        Fail("span plan shorter than batch counts");
+        return false;
+      }
+      auto span = spans_[next_span_++];
+      if (!ReadSpan(span.first, span.second, out)) return false;
+    }
+    // real plans have >=1 record of >=8 bytes per batch; an empty batch is
+    // treated as end-of-plan, matching the Python path's `data or None`
+    return !out->empty();
+  }
+
+  // read [offset, offset+size) of the concatenation, crossing file bounds
+  bool ReadSpan(int64_t offset, int64_t size, std::vector<char> *out) {
+    size_t head = out->size();
+    out->resize(head + static_cast<size_t>(size));
+    char *dst = out->data() + head;
+    while (size > 0) {
+      size_t idx = UpperBound(offset);
+      if (idx >= files_.size()) { Fail("span beyond input"); return false; }
+      if (!EnsureOpen(idx)) return false;
+      int64_t local = offset - offsets_[idx];
+      if (curr_ != local) {
+        if (Seek64(fp_, local) != 0) { Fail("seek failed"); return false; }
+        curr_ = local;
+      }
+      int64_t avail = std::min(size, files_[idx].size - local);
+      int64_t got = 0;
+      while (got < avail) {
+        size_t n = std::fread(dst + got, 1,
+                              static_cast<size_t>(avail - got), fp_);
+        if (n == 0) { Fail("short read in " + files_[idx].path); return false; }
+        got += static_cast<int64_t>(n);
+      }
+      curr_ += got;
+      dst += got;
+      offset += got;
+      size -= got;
+    }
+    return true;
+  }
+
+  size_t UpperBound(int64_t offset) const {
+    auto it = std::upper_bound(offsets_.begin(), offsets_.end(), offset);
+    return static_cast<size_t>(it - offsets_.begin()) - 1;
+  }
+
+  bool EnsureOpen(size_t idx) {
+    if (fp_ && file_ptr_ == idx) return true;
+    CloseFile();
+    fp_ = std::fopen(files_[idx].path.c_str(), "rb");
+    if (!fp_) { Fail("cannot open " + files_[idx].path); return false; }
+    file_ptr_ = idx;
+    curr_ = 0;
+    return true;
+  }
+
+  void CloseFile() {
+    if (fp_) { std::fclose(fp_); fp_ = nullptr; }
+  }
+
+  void Fail(const std::string &msg) {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    if (error_.empty()) error_ = msg;
+  }
+
+  std::vector<FileEnt> files_;
+  std::vector<int64_t> offsets_;
+  std::vector<std::pair<int64_t, int64_t>> spans_;
+  std::vector<int64_t> counts_;
+  int64_t next_batch_ = 0, next_span_ = 0;
+  std::FILE *fp_ = nullptr;
+  size_t file_ptr_ = 0;
+  int64_t curr_ = 0;
+  mutable std::mutex err_mu_;
+  std::string error_;
+  PrefetchQueue queue_;
 };
 
 struct SplitHandle {
@@ -309,6 +518,24 @@ struct SplitHandle {
   std::vector<char> current;  // chunk handed to Python, valid until next call
   std::string error;
 };
+
+struct SpanHandle {
+  SpanReadEngine *engine = nullptr;
+  std::vector<char> current;
+  std::string error;
+};
+
+std::vector<FileEnt> DecodeFiles(const char *paths, const int64_t *path_lens,
+                                 const int64_t *sizes, int64_t nfiles) {
+  std::vector<FileEnt> files;
+  const char *p = paths;
+  for (int64_t i = 0; i < nfiles; ++i) {
+    files.push_back({std::string(p, static_cast<size_t>(path_lens[i])),
+                     sizes[i]});
+    p += path_lens[i];
+  }
+  return files;
+}
 
 }  // namespace
 
@@ -322,17 +549,65 @@ void *dmlc_tpu_lsplit_open(const char *paths, const int64_t *path_lens,
                            int64_t part, int64_t nparts,
                            int64_t buffer_size) {
   auto *h = new SplitHandle();
-  std::vector<FileEnt> files;
-  const char *p = paths;
-  for (int64_t i = 0; i < nfiles; ++i) {
-    files.push_back({std::string(p, static_cast<size_t>(path_lens[i])),
-                     sizes[i]});
-    p += path_lens[i];
-  }
-  h->engine = new LineSplitEngine(std::move(files), buffer_size);
+  h->engine = new LineSplitEngine(
+      DecodeFiles(paths, path_lens, sizes, nfiles), buffer_size, kLine);
   h->engine->ResetPartition(part, nparts);
   if (h->engine->failed()) h->error = h->engine->Error();
   return h;
+}
+
+// RecordIO variant: same handle/call surface as lsplit_* (hint/total/reset/
+// next_chunk/error/close all apply), only the record format differs
+void *dmlc_tpu_rsplit_open(const char *paths, const int64_t *path_lens,
+                           const int64_t *sizes, int64_t nfiles,
+                           int64_t part, int64_t nparts,
+                           int64_t buffer_size) {
+  auto *h = new SplitHandle();
+  h->engine = new LineSplitEngine(
+      DecodeFiles(paths, path_lens, sizes, nfiles), buffer_size, kRecordIO);
+  h->engine->ResetPartition(part, nparts);
+  if (h->engine->failed()) h->error = h->engine->Error();
+  return h;
+}
+
+// ---- index-driven span reader (indexed recordio batches) -------------------
+
+void *dmlc_tpu_span_open(const char *paths, const int64_t *path_lens,
+                         const int64_t *sizes, int64_t nfiles) {
+  auto *h = new SpanHandle();
+  h->engine = new SpanReadEngine(DecodeFiles(paths, path_lens, sizes, nfiles));
+  return h;
+}
+
+void dmlc_tpu_span_set_plan(void *handle, const int64_t *offs,
+                            const int64_t *sizes, const int64_t *counts,
+                            int64_t nspans, int64_t nbatches) {
+  auto *h = static_cast<SpanHandle *>(handle);
+  h->error.clear();
+  h->engine->SetPlan(offs, sizes, counts, nspans, nbatches);
+}
+
+// returns chunk length (>0), 0 at plan end, -1 on error
+int64_t dmlc_tpu_span_next_chunk(void *handle, const char **ptr) {
+  auto *h = static_cast<SpanHandle *>(handle);
+  if (!h->error.empty()) return -1;
+  if (!h->engine->PopChunk(&h->current)) {
+    if (h->engine->failed()) { h->error = h->engine->Error(); return -1; }
+    return 0;
+  }
+  if (h->engine->failed()) { h->error = h->engine->Error(); return -1; }
+  *ptr = h->current.data();
+  return static_cast<int64_t>(h->current.size());
+}
+
+const char *dmlc_tpu_span_error(void *handle) {
+  return static_cast<SpanHandle *>(handle)->error.c_str();
+}
+
+void dmlc_tpu_span_close(void *handle) {
+  auto *h = static_cast<SpanHandle *>(handle);
+  delete h->engine;
+  delete h;
 }
 
 void dmlc_tpu_lsplit_hint(void *handle, int64_t chunk_size) {

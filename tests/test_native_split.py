@@ -174,3 +174,167 @@ def _records_noclose(split):
         if r is None:
             return out
         out.append(bytes(r))
+
+
+# ---- native RecordIO splitter --------------------------------------------
+
+def _make_rec_files(tmp_path, nfiles=2, nrec=400, seed=5):
+    import random
+    import struct
+
+    from dmlc_core_tpu.io.memory_io import MemoryStringStream
+    from dmlc_core_tpu.io.recordio import RecordIOWriter
+
+    rng = random.Random(seed)
+    magic = struct.pack("<I", 0xCED7230A)
+    paths, records = [], []
+    for i in range(nfiles):
+        stream = MemoryStringStream()
+        writer = RecordIOWriter(stream)
+        for _ in range(nrec):
+            # deliberately embed the magic to exercise the escape path
+            body = b"".join(
+                magic if rng.random() < 0.3
+                else struct.pack("<I", rng.getrandbits(32))
+                for _ in range(rng.randint(0, 20)))
+            records.append(body)
+            writer.write_record(body)
+        p = tmp_path / f"d{i}.rec"
+        p.write_bytes(bytes(stream.data))
+        paths.append(str(p))
+    return ";".join(paths), records
+
+
+@pytest.mark.parametrize("nparts", [1, 2, 3, 7])
+def test_recordio_all_parts_match_python_engine(tmp_path, nparts):
+    from dmlc_core_tpu.io.input_split import RecordIOSplitter
+
+    uri, records = _make_rec_files(tmp_path)
+    fs = fsys.LocalFileSystem()
+    native_parts, python_parts = [], []
+    for part in range(nparts):
+        native_parts += _records(
+            NativeLineSplitter(fs, uri, part, nparts, format="recordio"))
+        python_parts += _records(RecordIOSplitter(fs, uri, part, nparts))
+    assert native_parts == python_parts, f"nparts={nparts}"
+    assert native_parts == records, f"nparts={nparts}"
+
+
+def test_recordio_factory_selects_native(tmp_path):
+    uri, records = _make_rec_files(tmp_path, nfiles=1, nrec=50)
+    split = create_input_split(uri, 0, 1, type="recordio")
+    assert isinstance(split, NativeLineSplitter)
+    assert _records(split) == records
+
+
+def test_recordio_native_chunks_match_python_chunks(tmp_path):
+    """Chunk boundaries (not just records) agree between engines, proving
+    the magic-resync FindLastRecordBegin parity."""
+    from dmlc_core_tpu.io.input_split import RecordIOSplitter
+
+    uri, _ = _make_rec_files(tmp_path, nfiles=1, nrec=300)
+    fs = fsys.LocalFileSystem()
+    nat = NativeLineSplitter(fs, uri, 0, 2, format="recordio")
+    py = RecordIOSplitter(fs, uri, 0, 2)
+    nat_chunks = list(iter(nat.next_chunk, None))
+    py_chunks = list(iter(py.next_chunk, None))
+    nat.close()
+    py.close()
+    assert b"".join(nat_chunks) == b"".join(py_chunks)
+
+
+# ---- native indexed span reads -------------------------------------------
+
+def _make_indexed(tmp_path, nrec=120, seed=9):
+    import random
+    import struct
+
+    from dmlc_core_tpu.io.memory_io import MemoryStringStream
+    from dmlc_core_tpu.io.recordio import RecordIOWriter
+
+    rng = random.Random(seed)
+    magic = struct.pack("<I", 0xCED7230A)
+    stream = MemoryStringStream()
+    writer = RecordIOWriter(stream)
+    offsets, records = [], []
+    for i in range(nrec):
+        offsets.append(len(stream.data))
+        body = (b"rec%05d" % i) + magic * (i % 3)
+        records.append(body)
+        writer.write_record(body)
+    rec = tmp_path / "data.rec"
+    rec.write_bytes(bytes(stream.data))
+    idx = tmp_path / "data.idx"
+    idx.write_text("".join(f"{i} {o}\n" for i, o in enumerate(offsets)))
+    return str(rec), str(idx), records
+
+
+@pytest.mark.parametrize("shuffle", [False, True])
+@pytest.mark.parametrize("nparts", [1, 3])
+def test_indexed_native_matches_python(tmp_path, monkeypatch, shuffle,
+                                       nparts):
+    from dmlc_core_tpu.io.input_split import IndexedRecordIOSplitter
+
+    rec, idx, records = _make_indexed(tmp_path)
+    fs = fsys.LocalFileSystem()
+
+    def run(disable_native):
+        out = []
+        for part in range(nparts):
+            split = IndexedRecordIOSplitter(fs, rec, idx, part, nparts,
+                                            batch_size=7, shuffle=shuffle,
+                                            seed=3)
+            if disable_native:
+                split._native_unavailable = True
+            else:
+                assert split._native_reader() is not None
+            out.append(_records(split))
+        return out
+
+    nat, py = run(False), run(True)
+    assert nat == py
+    flat = [r for part in nat for r in part]
+    assert sorted(flat) == sorted(records)
+    if not shuffle:
+        assert flat == records
+
+
+def test_indexed_native_epoch_reshuffles(tmp_path):
+    from dmlc_core_tpu.io.input_split import IndexedRecordIOSplitter
+
+    rec, idx, records = _make_indexed(tmp_path)
+    fs = fsys.LocalFileSystem()
+    split = IndexedRecordIOSplitter(fs, rec, idx, 0, 1, batch_size=7,
+                                    shuffle=True, seed=1)
+    assert split._native_reader() is not None
+    e1 = [bytes(r) for r in iter(split.next_record, None)]
+    split.before_first()
+    e2 = [bytes(r) for r in iter(split.next_record, None)]
+    split.close()
+    assert sorted(e1) == sorted(e2) == sorted(records)
+    assert e1 != e2
+
+
+def test_indexed_native_batch_size_change_resyncs(tmp_path):
+    """Changing the batch size mid-epoch abandons the native plan exactly at
+    the already-delivered boundary (no lost or repeated records)."""
+    from dmlc_core_tpu.io.input_split import IndexedRecordIOSplitter
+
+    rec, idx, records = _make_indexed(tmp_path)
+    fs = fsys.LocalFileSystem()
+    split = IndexedRecordIOSplitter(fs, rec, idx, 0, 1, batch_size=10)
+    assert split._native_reader() is not None
+    chunks = [split.next_chunk() for _ in range(3)]    # 30 records natively
+    split.set_batch_size(4)
+    rest = list(iter(split.next_chunk, None))
+    split.close()
+    got = []
+    from dmlc_core_tpu.io.input_split import ChunkCursor, _next_recordio_record
+    for c in chunks + rest:
+        cur = ChunkCursor(c)
+        while True:
+            r = _next_recordio_record(cur)
+            if r is None:
+                break
+            got.append(bytes(r))
+    assert got == records

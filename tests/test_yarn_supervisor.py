@@ -424,3 +424,57 @@ def test_rest_persistent_poll_errors_mark_container_lost(monkeypatch):
         assert sup.tasks[0].attempts == 1
     finally:
         rm.stop()
+
+
+def test_rest_resubmit_during_rm_outage_defers_to_backlog():
+    """A retry submission raced against an RM outage must not crash the
+    loop; the task is backlogged and submitted when the RM answers."""
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster, supervise
+
+    rm = StatefulMockRM(node_plan=["n0", "n1"], fail_plan={0}).start()
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(1),
+                                  {})
+        # make the first resubmission fail: drop the RM for exactly the
+        # new-application call that follows task 0's failure
+        orig_submit = cluster._submit_app
+        outage = {"armed": True}
+
+        def flaky_submit(task):
+            if task.attempts == 1 and outage["armed"]:
+                outage["armed"] = False
+                raise OSError("connection refused (simulated outage)")
+            orig_submit(task)
+
+        cluster._submit_app = flaky_submit
+        sup = supervise(cluster, num_workers=1, num_servers=0,
+                        poll_interval=0.01)
+        assert sup.done
+        assert sup.tasks[0].attempts == 1
+        assert len(rm.submissions) == 2    # retry landed despite the outage
+    finally:
+        rm.stop()
+
+
+def test_rest_fast_fail_before_running_on_blacklisted_node_still_counts():
+    """A terminal report for a never-RUNNING app must bump attempts even when
+    its node is already blacklisted (no burn/swallow)."""
+    from dmlc_core_tpu.tracker.yarn import RestYarnCluster
+    from dmlc_core_tpu.tracker.yarn_supervisor import ContainerSupervisor
+
+    rm = StatefulMockRM(node_plan=["node-a", "node-b"], fail_plan={0}).start()
+    try:
+        cluster = RestYarnCluster(f"http://127.0.0.1:{rm.port}", _yarn_opts(1),
+                                  {})
+        sup = ContainerSupervisor(cluster, num_workers=1, max_attempts=3)
+        sup.blacklist.add("node-a")
+        sup.start()
+        # poll 1 returns RUNNING; skip straight to a second poll where the
+        # app is FAILED — but simulate the fast-fail by dropping the
+        # RUNNING report: mark the app as instantly terminal
+        rm.apps["app_0"]["polls"] = 1   # next GET reports FAILED
+        cluster.poll(sup)               # allocation skipped: app terminal
+        assert sup.tasks[0].attempts == 1       # failure counted
+        assert len(rm.submissions) == 2         # retry submitted
+    finally:
+        rm.stop()
