@@ -516,12 +516,11 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._n_overflow = 0
         # native span reader: index policy (partitioning, shuffle) stays
         # here; the byte-moving + read-ahead runs in C++ when available.
-        # _native_unavailable is permanent (remote fs / no library);
-        # _native_disabled is epoch-scoped (batch size changed mid-plan) and
-        # cleared by the next before_first, which builds a fresh plan anyway
+        # _native_unavailable is permanent (remote fs / no library); a
+        # mid-epoch plan abandonment just drops the reader — before_first
+        # recreates it with a fresh plan
         self._span_reader = None
         self._native_unavailable = False
-        self._native_disabled = False
         self._plan_batch = batch_size
         self._popped = 0
         self.reset_partition(part_index, num_parts)
@@ -582,7 +581,6 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._n_overflow = 0
         if self._offset_begin < self._offset_end:
             InputSplitBase.before_first(self)
-        self._native_disabled = False   # a new epoch gets a fresh plan
         reader = self._native_reader()
         if reader is not None:
             offs, szs, counts = self._epoch_plan()
@@ -593,7 +591,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
     # -- native span fast path ----------------------------------------------
     def _native_reader(self):
         """The C++ span reader, created on first use (local files only)."""
-        if self._native_unavailable or self._native_disabled:
+        if self._native_unavailable:
             return None
         if self._span_reader is None:
             if not isinstance(self._filesys, fsys.LocalFileSystem):
@@ -640,7 +638,8 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
 
     def _resync_from_native(self) -> None:
         """Abandon the native plan (batch size changed mid-epoch): restore
-        the Python cursor from the number of batches already delivered."""
+        the Python cursor from the number of batches already delivered.
+        The next before_first() recreates the reader with a fresh plan."""
         consumed = self._popped * self._plan_batch
         if self._shuffle:
             self._current_index = min(consumed, len(self._permutation))
@@ -648,7 +647,6 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self._current_index = min(self._index_begin + consumed,
                                       self._index_end)
         self._n_overflow = 0
-        self._native_disabled = True
         if self._span_reader is not None:
             self._span_reader.close()
             self._span_reader = None
@@ -677,8 +675,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
 
     def next_batch_bytes(self, n_records: int) -> Optional[bytes]:
         """Read the next `n_records` batch as one chunk (reference NextBatchEx)."""
-        if (self._span_reader is not None and not self._native_disabled
-                and not self._native_unavailable):
+        if self._span_reader is not None and not self._native_unavailable:
             if n_records == self._plan_batch and not self._n_overflow:
                 chunk = self._span_reader.next_chunk()
                 if chunk is not None:

@@ -24,8 +24,11 @@ from __future__ import annotations
 
 import hashlib
 import http.client
+import logging
 import os
+import socket
 import ssl
+import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
@@ -40,6 +43,17 @@ from dmlc_core_tpu.utils.logging import CHECK, log_fatal
 __all__ = ["S3FileSystem", "GCSFileSystem"]
 
 _EMPTY_SHA = hashlib.sha256(b"").hexdigest()
+
+logger = logging.getLogger("dmlc_core_tpu.io.s3")
+
+# transport-level failures worth re-establishing a connection for
+# (the reference's curl!=CURLE_OK + short-read re-connect loops,
+# s3_filesys.cc:318-341 and :703-733)
+_RETRYABLE_EXC = (ConnectionError, socket.timeout, ssl.SSLError,
+                  http.client.IncompleteRead, http.client.BadStatusLine,
+                  http.client.CannotSendRequest, http.client.ResponseNotReady)
+# server statuses that are transient by contract
+_RETRYABLE_STATUS = (500, 502, 503)
 
 
 class _S3Client:
@@ -81,6 +95,17 @@ class _S3Client:
     def request(self, method: str, key: str, query: Optional[Dict] = None,
                 body: bytes = b"", headers: Optional[Dict] = None,
                 ok: Tuple[int, ...] = (200,)) -> Tuple[int, Dict[str, str], bytes]:
+        """One signed request with connection-reestablishing retry.
+
+        Transport failures (drops mid-transfer, resets, timeouts) and
+        transient 5xx statuses retry up to ``S3_MAX_ERROR_RETRY`` times with
+        100 ms doubling backoff — the reference re-connects the same way on
+        curl errors and short reads (s3_filesys.cc:318-341, 703-733; every
+        request here is a fresh connection, so a retry IS a re-connect).
+        All client request types are safe to repeat: GETs/HEADs are
+        idempotent, part PUTs re-upload the same part, and S3 treats a
+        repeated complete-multipart POST for the same upload as idempotent.
+        """
         query = {k: str(v) for k, v in (query or {}).items()}
         path = self.base_path + ("/" + key.lstrip("/") if key else "")
         payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA
@@ -89,18 +114,38 @@ class _S3Client:
                               service="s3")
         qs = urllib.parse.urlencode(sorted(query.items()))
         url = path + (f"?{qs}" if qs else "")
-        conn = self._connect()
-        try:
-            conn.request(method, url, body=body or None, headers=signed)
-            resp = conn.getresponse()
-            data = resp.read()
-            rheaders = {k.lower(): v for k, v in resp.getheaders()}
+        max_retry = get_env("S3_MAX_ERROR_RETRY", int, 3)
+        delay = 0.1
+        for attempt in range(max_retry + 1):
+            conn = self._connect()
+            try:
+                conn.request(method, url, body=body or None, headers=signed)
+                resp = conn.getresponse()
+                data = resp.read()
+                rheaders = {k.lower(): v for k, v in resp.getheaders()}
+            except _RETRYABLE_EXC as exc:
+                if attempt >= max_retry:
+                    raise
+                logger.warning("re-establishing connection to %s (%s %s, "
+                               "retry %d): %s", self.host, method, url,
+                               attempt + 1, exc)
+                time.sleep(delay)
+                delay *= 2
+                continue
+            finally:
+                conn.close()
+            if resp.status in _RETRYABLE_STATUS and resp.status not in ok \
+                    and attempt < max_retry:
+                logger.warning("%s %s returned %d; retry %d", method, url,
+                               resp.status, attempt + 1)
+                time.sleep(delay)
+                delay *= 2
+                continue
             if resp.status not in ok:
                 log_fatal(f"{self.service} error {resp.status} on "
                           f"{method} {url}: {data[:500]!r}")
             return resp.status, rheaders, data
-        finally:
-            conn.close()
+        raise AssertionError("unreachable")
 
 
 class S3ReadStream(SeekStream):
@@ -200,8 +245,19 @@ class S3WriteStream(Stream):
             for i, etag in enumerate(self._etags))
         body = (f"<CompleteMultipartUpload>{parts}"
                 f"</CompleteMultipartUpload>").encode()
-        self._client.request("POST", self._key,
-                             query={"uploadId": self._upload_id}, body=body)
+        # CompleteMultipartUpload is the one non-idempotent call: if a
+        # transport retry re-sends it after S3 already committed, S3 answers
+        # 404 NoSuchUpload.  Accept the 404 and verify the object landed —
+        # failing a fully successful checkpoint write would be worse than
+        # the extra HEAD.
+        status, _, _ = self._client.request(
+            "POST", self._key, query={"uploadId": self._upload_id},
+            body=body, ok=(200, 404))
+        if status == 404:
+            hs, _, _ = self._client.request("HEAD", self._key, ok=(200, 404))
+            CHECK(hs == 200,
+                  f"multipart upload of {self._key} lost: complete returned "
+                  "NoSuchUpload and the object does not exist")
 
     def __del__(self):
         try:

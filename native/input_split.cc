@@ -410,6 +410,9 @@ class SpanReadEngine {
   void SetPlan(const int64_t *offs, const int64_t *sizes,
                const int64_t *counts, int64_t nspans, int64_t nbatches) {
     queue_.Stop();
+    // a failed prior epoch may have left the OS file position ahead of
+    // curr_ (short-read abort); force a clean reopen + seek
+    CloseFile();
     {
       std::lock_guard<std::mutex> lk(err_mu_);
       error_.clear();
@@ -465,7 +468,11 @@ class SpanReadEngine {
       while (got < avail) {
         size_t n = std::fread(dst + got, 1,
                               static_cast<size_t>(avail - got), fp_);
-        if (n == 0) { Fail("short read in " + files_[idx].path); return false; }
+        if (n == 0) {
+          curr_ += got;  // keep curr_ == OS position even on the error path
+          Fail("short read in " + files_[idx].path);
+          return false;
+        }
         got += static_cast<int64_t>(n);
       }
       curr_ += got;

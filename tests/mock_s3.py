@@ -15,12 +15,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
 class MockS3:
-    def __init__(self):
+    def __init__(self, fail_every: int = 0):
         self.objects = {}      # (bucket, key) -> bytes
         self.uploads = {}      # upload_id -> {"key":..., "parts": {n: bytes}}
         self.next_upload = [0]
         self.lock = threading.Lock()
         self.requests = []     # (method, path) log
+        # failure injection for the concurrency soak (reference
+        # test/README.md protocol): every Nth GET is sabotaged — half the
+        # body, then the connection is torn down mid-transfer (0 = off)
+        self.fail_every = fail_every
+        self.injected_failures = 0
+        self._get_count = 0
 
     def start(self):
         store = self
@@ -69,6 +75,31 @@ class MockS3:
                     self._reply(200, b"", {"Content-Length": str(len(data))})
                     return
 
+            def _should_fail(self):
+                if not store.fail_every:
+                    return False
+                with store.lock:
+                    store._get_count += 1
+                    if store._get_count % store.fail_every == 0:
+                        store.injected_failures += 1
+                        return True
+                return False
+
+            def _drop_mid_body(self, status, body):
+                """Full Content-Length, half the bytes, then kill the
+                connection: the client sees IncompleteRead/reset mid-GET.
+                (shutdown(), not close(): the rfile/wfile makefile wrappers
+                hold socket refs, so close() alone never sends the FIN.)"""
+                import socket as socket_mod
+
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body[:max(1, len(body) // 2)])
+                self.wfile.flush()
+                self.close_connection = True
+                self.connection.shutdown(socket_mod.SHUT_RDWR)
+
             def do_GET(self):
                 if not self._check_auth():
                     return
@@ -85,7 +116,12 @@ class MockS3:
                     start_s, end_s = spec.split("-")
                     start = int(start_s)
                     end = min(int(end_s), len(data) - 1) if end_s else len(data) - 1
-                    return self._reply(206, data[start:end + 1])
+                    piece = data[start:end + 1]
+                    if self._should_fail():
+                        return self._drop_mid_body(206, piece)
+                    return self._reply(206, piece)
+                if self._should_fail():
+                    return self._drop_mid_body(200, data)
                 self._reply(200, data)
 
             def _list(self, bucket, query):

@@ -1,0 +1,162 @@
+"""hdfs:// code path exercised with real bytes through a pyarrow test double.
+
+No namenode exists in CI, so ``_arrow_fs`` is monkeypatched to return
+``pyarrow.fs.LocalFileSystem`` — the SAME ``pyarrow.fs`` API surface
+``HadoopFileSystem`` (libhdfs) implements, with HDFS-faithful absolute
+paths — so everything in ``io/hdfs_filesys.py`` except the namenode
+connection itself runs for real: stream read/write/seek/tell, path info,
+directory listing, and the Stream-contract integration (create_stream,
+InputSplit, RecordIO) the other remote backends already prove
+(reference src/io/hdfs_filesys.cc:10-91).
+"""
+
+import pytest
+
+from dmlc_core_tpu.io import filesys as fsys
+from dmlc_core_tpu.io import hdfs_filesys
+from dmlc_core_tpu.io.stream import create_stream, create_stream_for_read
+
+
+@pytest.fixture()
+def hdfs_root(tmp_path, monkeypatch):
+    """Route hdfs://namenode:9000<abs-path> to the local FS; returns a URI
+    builder so tests address files under tmp_path with absolute paths, the
+    way a real namenode serves them."""
+    from pyarrow import fs as pafs
+
+    local = pafs.LocalFileSystem()
+    seen = []
+
+    def fake_arrow_fs(uri):
+        seen.append(uri.host)
+        return local
+
+    monkeypatch.setattr(hdfs_filesys, "_arrow_fs", fake_arrow_fs)
+
+    def u(rel: str) -> str:
+        return f"hdfs://namenode:9000{tmp_path}/{rel}"
+
+    return tmp_path, u, seen
+
+
+def test_write_then_read_roundtrip(hdfs_root):
+    tmp_path, u, seen = hdfs_root
+    payload = b"hello hdfs\n" * 1000
+    fo = create_stream(u("a.bin"), "w")
+    fo.write(payload)
+    fo.close()
+    # bytes physically landed on disk
+    assert (tmp_path / "a.bin").read_bytes() == payload
+    fi = create_stream_for_read(u("a.bin"))
+    assert fi.read(5) == payload[:5]
+    assert fi.tell() == 5
+    fi.seek(len(payload) - 7)
+    assert fi.read(100) == payload[-7:]
+    fi.close()
+    assert "namenode:9000" in seen
+
+
+def test_get_path_info_and_missing(hdfs_root):
+    tmp_path, u, _ = hdfs_root
+    (tmp_path / "x.bin").write_bytes(b"12345678")
+    fs = fsys.get_filesystem(fsys.URI(u("x.bin")))
+    assert isinstance(fs, hdfs_filesys.HDFSFileSystem)
+    info = fs.get_path_info(fsys.URI(u("x.bin")))
+    assert info.size == 8
+    assert info.type == fsys.FileType.FILE
+    with pytest.raises(FileNotFoundError):
+        fs.get_path_info(fsys.URI(u("not-there")))
+
+
+def test_list_directory(hdfs_root):
+    tmp_path, u, _ = hdfs_root
+    (tmp_path / "d").mkdir()
+    (tmp_path / "d" / "a").write_bytes(b"aa")
+    (tmp_path / "d" / "b").write_bytes(b"bbbb")
+    (tmp_path / "d" / "sub").mkdir()
+    fs = fsys.get_filesystem(fsys.URI(u("d")))
+    infos = {i.path.name.rsplit("/", 1)[-1]: i
+             for i in fs.list_directory(fsys.URI(u("d")))}
+    assert set(infos) == {"a", "b", "sub"}
+    assert infos["a"].size == 2 and infos["a"].type == fsys.FileType.FILE
+    assert infos["sub"].type == fsys.FileType.DIRECTORY
+    # listings carry absolute paths (as a namenode would serve them)
+    assert all(i.path.name.startswith("/") for i in infos.values())
+
+
+def test_append_mode(hdfs_root):
+    tmp_path, u, _ = hdfs_root
+    fo = create_stream(u("log.txt"), "w")
+    fo.write(b"one")
+    fo.close()
+    fo = create_stream(u("log.txt"), "a")
+    fo.write(b"two")
+    fo.close()
+    assert (tmp_path / "log.txt").read_bytes() == b"onetwo"
+
+
+def test_input_split_over_hdfs(hdfs_root):
+    """The sharded-read engine runs over hdfs:// like any other FS (the
+    Stream contract is what the reference's HDFSStream exists to satisfy)."""
+    tmp_path, u, _ = hdfs_root
+    lines = [b"line-%d" % i for i in range(500)]
+    (tmp_path / "data.txt").write_bytes(b"\n".join(lines) + b"\n")
+    from dmlc_core_tpu.io.input_split import create_input_split
+
+    got = []
+    for part in range(3):
+        split = create_input_split(u("data.txt"), part, 3, "text",
+                                   threaded=False)
+        got += [bytes(r) for r in iter(split.next_record, None)]
+        split.close()
+    assert got == lines
+
+
+def test_recordio_over_hdfs(hdfs_root):
+    """RecordIO writer/reader through hdfs:// streams (checkpoint-shaped IO:
+    Stream::Create('hdfs://...') + Serializable, SURVEY §3.5)."""
+    from dmlc_core_tpu.io.recordio import RecordIOReader, RecordIOWriter
+
+    _, u, _ = hdfs_root
+    recs = [b"r%d" % i * (i % 7 + 1) for i in range(200)]
+    fo = create_stream(u("data.rec"), "w")
+    w = RecordIOWriter(fo)
+    for r in recs:
+        w.write_record(r)
+    fo.close()
+    fi = create_stream_for_read(u("data.rec"))
+    reader = RecordIOReader(fi)
+    got = [bytes(r) for r in iter(reader.next_record, None)]
+    fi.close()
+    assert got == recs
+
+
+def test_checkpoint_over_hdfs(hdfs_root):
+    """Pytree checkpoints land on hdfs:// URIs (the reference's
+    'checkpoint = Save to any URI' pattern, SURVEY §5.4)."""
+    import numpy as np
+
+    from dmlc_core_tpu.bridge.checkpoint import (load_checkpoint,
+                                                 save_checkpoint)
+
+    _, u, _ = hdfs_root
+    tree = {"w": np.arange(100, dtype=np.float32), "step": np.int64(7)}
+    save_checkpoint(u("ckpt"), tree)
+    back = load_checkpoint(u("ckpt"))
+    np.testing.assert_array_equal(back["['w']"], tree["w"])
+
+
+def test_gate_message_without_pyarrow(monkeypatch):
+    """Absent pyarrow keeps the reference's compiled-without-HDFS failure."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_pyarrow(name, *a, **k):
+        if name.startswith("pyarrow"):
+            raise ImportError("no pyarrow")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_pyarrow)
+    with pytest.raises(Exception, match="pyarrow"):
+        hdfs_filesys._arrow_fs(fsys.URI("hdfs://nn/x"))
