@@ -52,8 +52,9 @@ logger = logging.getLogger("dmlc_core_tpu.io.s3")
 _RETRYABLE_EXC = (ConnectionError, socket.timeout, ssl.SSLError,
                   http.client.IncompleteRead, http.client.BadStatusLine,
                   http.client.CannotSendRequest, http.client.ResponseNotReady)
-# server statuses that are transient by contract
-_RETRYABLE_STATUS = (500, 502, 503)
+# server statuses that are transient by contract (503 SlowDown on S3,
+# 429 rateLimitExceeded on the GCS interop API)
+_RETRYABLE_STATUS = (429, 500, 502, 503)
 
 
 class _S3Client:
@@ -201,6 +202,7 @@ class S3WriteStream(Stream):
         self._part_bytes = max(5, self._buffer_mb) << 20
         self._upload_id: Optional[str] = None
         self._etags: List[str] = []
+        self._total_bytes = 0
         self._closed = False
 
     def _init_multipart(self) -> None:
@@ -214,6 +216,7 @@ class S3WriteStream(Stream):
         self._upload_id = node.text
 
     def write(self, data: bytes) -> None:
+        self._total_bytes += len(data)
         self._buffer.extend(data)
         while len(self._buffer) >= self._part_bytes:
             self._upload_part(bytes(self._buffer[:self._part_bytes]))
@@ -247,17 +250,22 @@ class S3WriteStream(Stream):
                 f"</CompleteMultipartUpload>").encode()
         # CompleteMultipartUpload is the one non-idempotent call: if a
         # transport retry re-sends it after S3 already committed, S3 answers
-        # 404 NoSuchUpload.  Accept the 404 and verify the object landed —
-        # failing a fully successful checkpoint write would be worse than
-        # the extra HEAD.
+        # 404 NoSuchUpload.  Accept the 404 only when the object at the key
+        # has exactly the bytes we uploaded — a bare existence check would
+        # mistake a stale object under an overwritten key for success.
         status, _, _ = self._client.request(
             "POST", self._key, query={"uploadId": self._upload_id},
             body=body, ok=(200, 404))
         if status == 404:
-            hs, _, _ = self._client.request("HEAD", self._key, ok=(200, 404))
-            CHECK(hs == 200,
+            hs, headers, _ = self._client.request("HEAD", self._key,
+                                                  ok=(200, 404))
+            landed = (hs == 200 and
+                      int(headers.get("content-length", -1))
+                      == self._total_bytes)
+            CHECK(landed,
                   f"multipart upload of {self._key} lost: complete returned "
-                  "NoSuchUpload and the object does not exist")
+                  f"NoSuchUpload and the object is missing or has the wrong "
+                  f"size (expected {self._total_bytes} bytes)")
 
     def __del__(self):
         try:

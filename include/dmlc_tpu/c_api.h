@@ -1,0 +1,85 @@
+/*!
+ * C ABI of libdmlc_tpu_native.so — the symbol surface C++ consumers link
+ * against (implemented in native/parsers.cc, native/recordio.cc,
+ * native/input_split.cc; the same ABI the Python package drives via ctypes,
+ * dmlc_core_tpu/native_bridge.py).
+ *
+ * This is the rebuild's answer to the reference's "downstream C++ libraries
+ * consume the C++ API" commitment (SURVEY §7; reference
+ * include/dmlc/parameter.h:113-218): a stable C ABI plus the header-only
+ * C++ views in this directory (parameter.h, registry.h, input_split.h).
+ */
+#ifndef DMLC_TPU_C_API_H_
+#define DMLC_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- chunk parsers (native/parsers.cc) --------------------------------- */
+/* Handles are opaque; on error dims() reports n_rows = -1 and
+ * dmlc_tpu_error_msg() carries the message.  flags: 1=weight 2=value
+ * 4=field 8=dense. */
+void *dmlc_tpu_parse_libsvm(const char *data, int64_t len, int nthread);
+void *dmlc_tpu_parse_libfm(const char *data, int64_t len, int nthread);
+void *dmlc_tpu_parse_csv(const char *data, int64_t len, int nthread);
+void dmlc_tpu_result_dims(void *handle, int64_t *n_rows, int64_t *nnz,
+                          int64_t *n_cols, int32_t *flags);
+const char *dmlc_tpu_error_msg(void *handle);
+void dmlc_tpu_result_fill(void *handle, int64_t *offset, float *label,
+                          float *weight, uint32_t *index, uint32_t *field,
+                          float *value, float *dense);
+void dmlc_tpu_result_free(void *handle);
+
+/* ---- RecordIO helpers (native/parsers.cc, native/recordio.cc) ---------- */
+int64_t dmlc_tpu_find_magic(const char *data, int64_t len, uint32_t magic,
+                            int64_t *out, int64_t out_cap);
+void *dmlc_tpu_recordio_scan(const char *data, int64_t len, int64_t begin,
+                             int64_t end);
+void dmlc_tpu_recordio_scan_dims(void *handle, int64_t *n, int64_t *pbegin,
+                                 int64_t *pend);
+const char *dmlc_tpu_recordio_scan_error(void *handle);
+void dmlc_tpu_recordio_scan_fill(void *handle, int64_t *head, int64_t *plen,
+                                 uint8_t *escaped);
+void dmlc_tpu_recordio_scan_free(void *handle);
+int64_t dmlc_tpu_recordio_extract(const char *data, int64_t len, int64_t head,
+                                  void *out, int64_t out_len);
+void *dmlc_tpu_recordio_frame(const char *payloads, void *lens, int64_t n);
+void dmlc_tpu_frame_dims(void *handle, int64_t *size, int64_t *n_off,
+                         int64_t *nexc);
+const char *dmlc_tpu_frame_error(void *handle);
+void dmlc_tpu_frame_fill(void *handle, void *out, void *offsets);
+void dmlc_tpu_frame_free(void *handle);
+
+/* ---- sharded input splits (native/input_split.cc) ----------------------- */
+/* paths: concatenated path bytes, per-path byte lengths in path_lens. */
+void *dmlc_tpu_lsplit_open(const char *paths, const int64_t *path_lens,
+                           const int64_t *sizes, int64_t nfiles, int64_t part,
+                           int64_t nparts, int64_t buffer_size);
+void *dmlc_tpu_rsplit_open(const char *paths, const int64_t *path_lens,
+                           const int64_t *sizes, int64_t nfiles, int64_t part,
+                           int64_t nparts, int64_t buffer_size);
+void dmlc_tpu_lsplit_hint(void *handle, int64_t chunk_size);
+int64_t dmlc_tpu_lsplit_total(void *handle);
+void dmlc_tpu_lsplit_reset(void *handle, int64_t part, int64_t nparts);
+int64_t dmlc_tpu_lsplit_next_chunk(void *handle, const char **ptr);
+const char *dmlc_tpu_lsplit_error(void *handle);
+void dmlc_tpu_lsplit_close(void *handle);
+
+/* ---- index-driven span reader (native/input_split.cc) ------------------ */
+void *dmlc_tpu_span_open(const char *paths, const int64_t *path_lens,
+                         const int64_t *sizes, int64_t nfiles);
+void dmlc_tpu_span_set_plan(void *handle, const int64_t *offs,
+                            const int64_t *sizes, const int64_t *counts,
+                            int64_t nspans, int64_t nbatches);
+int64_t dmlc_tpu_span_next_chunk(void *handle, const char **ptr);
+const char *dmlc_tpu_span_error(void *handle);
+void dmlc_tpu_span_close(void *handle);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* DMLC_TPU_C_API_H_ */

@@ -1,0 +1,152 @@
+/*!
+ * RAII C++ views over the native split/parser C ABI (c_api.h): sharded
+ * chunk reads with built-in prefetch, and RowBlock-shaped parse results —
+ * the reference's InputSplit (include/dmlc/io.h:135-280) + RowBlock
+ * (include/dmlc/data.h:69-214) consumer surface for native code.
+ */
+#ifndef DMLC_TPU_INPUT_SPLIT_H_
+#define DMLC_TPU_INPUT_SPLIT_H_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "dmlc_tpu/c_api.h"
+
+namespace dmlc_tpu {
+
+/*! \brief one input file: path + size in bytes. */
+struct FileSpec {
+  std::string path;
+  int64_t size;
+};
+
+namespace detail {
+struct EncodedFiles {
+  std::string blob;
+  std::vector<int64_t> lens, sizes;
+  explicit EncodedFiles(const std::vector<FileSpec> &files) {
+    for (const auto &f : files) {
+      blob += f.path;
+      lens.push_back(static_cast<int64_t>(f.path.size()));
+      sizes.push_back(f.size);
+    }
+  }
+};
+}  // namespace detail
+
+/*!
+ * \brief sharded record-aligned chunk reader (line or RecordIO records)
+ * with a native prefetch thread; partition `part` of `nparts` over the
+ * concatenation of `files` (reference InputSplit::Create, src/io.cc:63-117).
+ */
+class InputSplit {
+ public:
+  enum class Format { kLine, kRecordIO };
+
+  InputSplit(const std::vector<FileSpec> &files, int64_t part, int64_t nparts,
+             Format format = Format::kLine,
+             int64_t buffer_size = 8 << 20) {
+    detail::EncodedFiles enc(files);
+    auto open = format == Format::kRecordIO ? &dmlc_tpu_rsplit_open
+                                            : &dmlc_tpu_lsplit_open;
+    handle_ = open(enc.blob.data(), enc.lens.data(), enc.sizes.data(),
+                   static_cast<int64_t>(enc.lens.size()), part, nparts,
+                   buffer_size);
+    try {
+      Check();
+    } catch (...) {
+      // the destructor never runs for a throwing constructor
+      dmlc_tpu_lsplit_close(handle_);
+      handle_ = nullptr;
+      throw;
+    }
+  }
+  ~InputSplit() {
+    if (handle_) dmlc_tpu_lsplit_close(handle_);
+  }
+  InputSplit(const InputSplit &) = delete;
+  InputSplit &operator=(const InputSplit &) = delete;
+
+  /*! \brief total bytes across all files. */
+  int64_t TotalSize() const { return dmlc_tpu_lsplit_total(handle_); }
+
+  /*! \brief re-shard (or rewind with the same arguments). */
+  void ResetPartition(int64_t part, int64_t nparts) {
+    dmlc_tpu_lsplit_reset(handle_, part, nparts);
+    Check();
+  }
+
+  /*! \brief grow the typical chunk size (io.h HintChunkSize). */
+  void HintChunkSize(int64_t size) { dmlc_tpu_lsplit_hint(handle_, size); }
+
+  /*!
+   * \brief next chunk of whole records; false at partition end.  The
+   * returned view stays valid until the next call on this object.
+   */
+  bool NextChunk(const char **data, int64_t *size) {
+    const char *ptr = nullptr;
+    int64_t n = dmlc_tpu_lsplit_next_chunk(handle_, &ptr);
+    if (n < 0) Check();
+    if (n <= 0) return false;
+    *data = ptr;
+    *size = n;
+    return true;
+  }
+
+ private:
+  void Check() const {
+    const char *err = dmlc_tpu_lsplit_error(handle_);
+    if (err && err[0]) throw std::runtime_error(err);
+  }
+  void *handle_ = nullptr;
+};
+
+/*!
+ * \brief CSR parse result (RowBlock, data.h:69-214): row i spans
+ * [offset[i], offset[i+1]) of index/value.
+ */
+struct RowBlock {
+  std::vector<int64_t> offset;
+  std::vector<float> label;
+  std::vector<float> weight;   // empty unless any row carried one
+  std::vector<uint32_t> index;
+  std::vector<uint32_t> field; // libfm only
+  std::vector<float> value;    // empty for implicit-1 libsvm rows
+
+  int64_t num_rows() const {
+    return offset.empty() ? 0 : static_cast<int64_t>(offset.size()) - 1;
+  }
+};
+
+/*! \brief parse one libsvm text chunk with `nthread` native threads. */
+inline RowBlock ParseLibSVM(const char *data, int64_t len, int nthread = 4) {
+  void *h = dmlc_tpu_parse_libsvm(data, len, nthread);
+  int64_t n_rows = 0, nnz = 0, n_cols = 0;
+  int32_t flags = 0;
+  dmlc_tpu_result_dims(h, &n_rows, &nnz, &n_cols, &flags);
+  if (n_rows < 0) {
+    std::string msg = dmlc_tpu_error_msg(h);
+    dmlc_tpu_result_free(h);
+    throw std::runtime_error(msg);
+  }
+  RowBlock out;
+  out.offset.resize(n_rows + 1);
+  out.label.resize(n_rows);
+  if (flags & 1) out.weight.resize(n_rows);
+  out.index.resize(nnz);
+  if (flags & 2) out.value.resize(nnz);
+  dmlc_tpu_result_fill(h, out.offset.data(), out.label.data(),
+                       out.weight.empty() ? nullptr : out.weight.data(),
+                       out.index.data(), nullptr,
+                       out.value.empty() ? nullptr : out.value.data(),
+                       nullptr);
+  dmlc_tpu_result_free(h);
+  return out;
+}
+
+}  // namespace dmlc_tpu
+
+#endif  // DMLC_TPU_INPUT_SPLIT_H_

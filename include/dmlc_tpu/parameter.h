@@ -1,0 +1,270 @@
+/*!
+ * Header-only C++ parameter system: the reference dmlc::Parameter's
+ * capability surface (include/dmlc/parameter.h:113-218) for native
+ * consumers of this framework, sharing semantics with the Python system
+ * (dmlc_core_tpu/param.py): declared typed fields with defaults, range
+ * checks, string enums, kwargs Init with an unknown-key policy, and
+ * docstring generation.
+ *
+ * Member pointers replace the reference's offset arithmetic — same
+ * reflection, modern C++ (no macros required to declare fields):
+ *
+ *   struct MyParam : public dmlc_tpu::Parameter<MyParam> {
+ *     int num_hidden = 0;
+ *     float lr = 0.01f;
+ *     std::string act = "relu";
+ *     static void Declare(dmlc_tpu::ParamManager<MyParam> &m) {
+ *       m.Field("num_hidden", &MyParam::num_hidden)
+ *           .set_range(0, 1 << 20).describe("hidden units");
+ *       m.Field("lr", &MyParam::lr).set_default(0.01f).describe("step size");
+ *       m.Field("act", &MyParam::act).set_enum({"relu", "tanh"})
+ *           .set_default("relu");
+ *     }
+ *   };
+ *   MyParam p; p.Init({{"num_hidden", "128"}});
+ */
+#ifndef DMLC_TPU_PARAMETER_H_
+#define DMLC_TPU_PARAMETER_H_
+
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+namespace dmlc_tpu {
+
+/*! \brief error thrown on bad parameter values (reference ParamError). */
+struct ParamError : public std::runtime_error {
+  explicit ParamError(const std::string &msg) : std::runtime_error(msg) {}
+};
+
+namespace detail {
+
+template <typename T>
+inline bool ParseValue(const std::string &s, T *out) {
+  std::istringstream is(s);
+  is >> *out;
+  return !is.fail() && is.eof();
+}
+
+template <>
+inline bool ParseValue<std::string>(const std::string &s, std::string *out) {
+  *out = s;
+  return true;
+}
+
+template <>
+inline bool ParseValue<bool>(const std::string &s, bool *out) {
+  if (s == "true" || s == "True" || s == "1") { *out = true; return true; }
+  if (s == "false" || s == "False" || s == "0") { *out = false; return true; }
+  return false;
+}
+
+template <typename T>
+inline std::string ToString(const T &v) {
+  std::ostringstream os;
+  os << v;
+  return os.str();
+}
+
+inline std::string ToString(bool v) { return v ? "true" : "false"; }
+
+}  // namespace detail
+
+template <typename PType>
+class ParamManager;
+
+namespace detail {
+
+/*! \brief type-erased declared field (reference FieldEntry). */
+template <typename PType>
+struct FieldBase {
+  std::string name, help, type_name;
+  bool required = true;      // no default set => must appear in kwargs
+  virtual ~FieldBase() = default;
+  virtual void Set(PType *p, const std::string &value) const = 0;
+  virtual void SetDefault(PType *p) const = 0;
+  virtual std::string DefaultString() const = 0;
+};
+
+template <typename PType, typename T>
+struct FieldEntry : public FieldBase<PType> {
+  T PType::*ptr = nullptr;
+  T default_value{};
+  bool has_lower = false, has_upper = false;
+  T lower{}, upper{};
+  std::vector<std::string> enum_values;   // string fields only
+
+  // -- declaration chain (mirrors param.py field(...) kwargs) -------------
+  FieldEntry &set_default(const T &v) {
+    default_value = v;
+    this->required = false;
+    return *this;
+  }
+  FieldEntry &set_range(const T &lo, const T &hi) {
+    lower = lo; upper = hi;
+    has_lower = has_upper = true;
+    return *this;
+  }
+  FieldEntry &set_lower_bound(const T &lo) {
+    lower = lo; has_lower = true;
+    return *this;
+  }
+  FieldEntry &set_enum(std::vector<std::string> vals) {
+    enum_values = std::move(vals);
+    return *this;
+  }
+  FieldEntry &describe(const std::string &help_text) {
+    this->help = help_text;
+    return *this;
+  }
+
+  // -- reflection ---------------------------------------------------------
+  void Set(PType *p, const std::string &value) const override {
+    T v{};
+    if (!ParseValue<T>(value, &v)) {
+      throw ParamError("Invalid value \"" + value + "\" for parameter " +
+                       this->name + " of type " + this->type_name);
+    }
+    Check(v);
+    p->*ptr = v;
+  }
+  void SetDefault(PType *p) const override {
+    if (this->required) {
+      throw ParamError("required parameter " + this->name + " is not set");
+    }
+    p->*ptr = default_value;
+  }
+  std::string DefaultString() const override {
+    return this->required ? std::string("required")
+                          : ToString(default_value);
+  }
+
+ private:
+  void Check(const T &v) const {
+    if ((has_lower && v < lower) || (has_upper && v > upper)) {
+      std::ostringstream os;
+      os << "value " << v << " for parameter " << this->name
+         << " is out of range";
+      if (has_lower && has_upper) os << " [" << lower << ", " << upper << "]";
+      throw ParamError(os.str());
+    }
+    if constexpr (std::is_same_v<T, std::string>) {
+      if (!enum_values.empty()) {
+        for (const auto &e : enum_values) {
+          if (e == v) return;
+        }
+        throw ParamError("value \"" + v + "\" for parameter " + this->name +
+                         " is not one of the allowed values");
+      }
+    }
+  }
+};
+
+template <typename T>
+inline const char *TypeName() { return "value"; }
+template <> inline const char *TypeName<int>() { return "int"; }
+template <> inline const char *TypeName<int64_t>() { return "long"; }
+template <> inline const char *TypeName<float>() { return "float"; }
+template <> inline const char *TypeName<double>() { return "double"; }
+template <> inline const char *TypeName<bool>() { return "boolean"; }
+template <> inline const char *TypeName<std::string>() { return "string"; }
+
+}  // namespace detail
+
+/*! \brief per-PType field table, built once by PType::Declare (the
+ * reference's ParamManager + __DECLARE__ singleton, parameter.h:286-494). */
+template <typename PType>
+class ParamManager {
+ public:
+  static ParamManager &Get() {
+    static ParamManager *inst = [] {
+      auto *m = new ParamManager();
+      PType::Declare(*m);
+      return m;
+    }();
+    return *inst;
+  }
+
+  template <typename T>
+  detail::FieldEntry<PType, T> &Field(const std::string &name, T PType::*ptr) {
+    auto e = std::make_unique<detail::FieldEntry<PType, T>>();
+    e->name = name;
+    e->ptr = ptr;
+    e->type_name = detail::TypeName<T>();
+    auto &ref = *e;
+    fields_.push_back(std::move(e));
+    return ref;
+  }
+
+  void RunInit(PType *p,
+               const std::map<std::string, std::string> &kwargs,
+               bool allow_unknown) const {
+    std::map<std::string, bool> seen;
+    for (const auto &kv : kwargs) {
+      const detail::FieldBase<PType> *f = FindField(kv.first);
+      if (f == nullptr) {
+        if (allow_unknown) continue;
+        throw ParamError("unknown parameter \"" + kv.first + "\"" +
+                         " (candidates: " + Candidates() + ")");
+      }
+      f->Set(p, kv.second);
+      seen[kv.first] = true;
+    }
+    for (const auto &f : fields_) {
+      if (!seen.count(f->name)) f->SetDefault(p);
+    }
+  }
+
+  /*! \brief generated docstring (reference __DOC__, parameter.h:463-471). */
+  std::string DocString() const {
+    std::ostringstream os;
+    for (const auto &f : fields_) {
+      os << f->name << " : " << f->type_name << ", default="
+         << f->DefaultString() << "\n";
+      if (!f->help.empty()) os << "    " << f->help << "\n";
+    }
+    return os.str();
+  }
+
+ private:
+  const detail::FieldBase<PType> *FindField(const std::string &name) const {
+    for (const auto &f : fields_) {
+      if (f->name == name) return f.get();
+    }
+    return nullptr;
+  }
+  std::string Candidates() const {
+    std::string out;
+    for (const auto &f : fields_) {
+      if (!out.empty()) out += ", ";
+      out += f->name;
+    }
+    return out;
+  }
+  std::vector<std::unique_ptr<detail::FieldBase<PType>>> fields_;
+};
+
+/*! \brief CRTP base (reference Parameter<PType>, parameter.h:113-218). */
+template <typename PType>
+class Parameter {
+ public:
+  void Init(const std::map<std::string, std::string> &kwargs) {
+    ParamManager<PType>::Get().RunInit(static_cast<PType *>(this), kwargs,
+                                       /*allow_unknown=*/false);
+  }
+  void InitAllowUnknown(const std::map<std::string, std::string> &kwargs) {
+    ParamManager<PType>::Get().RunInit(static_cast<PType *>(this), kwargs,
+                                       /*allow_unknown=*/true);
+  }
+  static std::string DocString() {
+    return ParamManager<PType>::Get().DocString();
+  }
+};
+
+}  // namespace dmlc_tpu
+
+#endif  // DMLC_TPU_PARAMETER_H_

@@ -1,0 +1,98 @@
+/*!
+ * Header-only registry: the reference dmlc::Registry's capability surface
+ * (include/dmlc/registry.h:26-122) — a per-entry-type singleton mapping
+ * names (and aliases) to factory entries, shared semantics with the Python
+ * registry (dmlc_core_tpu/registry.py).
+ *
+ *   struct ParserEntry {
+ *     std::string name, description;
+ *     std::function<Parser*(...)> body;
+ *   };
+ *   auto &e = dmlc_tpu::Registry<ParserEntry>::Get()->Register("libsvm");
+ *   e.body = ...;
+ *   auto *found = dmlc_tpu::Registry<ParserEntry>::Get()->Find("libsvm");
+ */
+#ifndef DMLC_TPU_REGISTRY_H_
+#define DMLC_TPU_REGISTRY_H_
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace dmlc_tpu {
+
+template <typename EntryType>
+class Registry {
+ public:
+  /*! \brief the per-EntryType singleton (reference Registry::Get()). */
+  static Registry *Get() {
+    static Registry inst;
+    return &inst;
+  }
+
+  /*! \brief register a new entry; duplicate names throw. */
+  EntryType &Register(const std::string &name) {
+    if (map_.count(name)) {
+      throw std::runtime_error("entry \"" + name + "\" already registered");
+    }
+    auto entry = std::make_unique<EntryType>();
+    entry->name = name;
+    EntryType &ref = *entry;
+    map_[name] = ref_or_own{entry.get()};
+    entries_.push_back(std::move(entry));
+    names_.push_back(name);
+    return ref;
+  }
+
+  /*! \brief alias an existing entry under a second name (registry.h:62-72). */
+  Registry &AddAlias(const std::string &name, const std::string &alias) {
+    auto it = map_.find(name);
+    if (it == map_.end()) {
+      throw std::runtime_error("cannot alias unknown entry \"" + name + "\"");
+    }
+    if (map_.count(alias)) {
+      throw std::runtime_error("alias \"" + alias + "\" already registered");
+    }
+    map_[alias] = it->second;
+    return *this;
+  }
+
+  /*! \brief entry by name/alias, or nullptr. */
+  EntryType *Find(const std::string &name) const {
+    auto it = map_.find(name);
+    return it == map_.end() ? nullptr : it->second.ptr;
+  }
+
+  /*! \brief registration-ordered primary names (no aliases). */
+  const std::vector<std::string> &ListAllNames() const { return names_; }
+
+ private:
+  struct ref_or_own { EntryType *ptr; };
+  Registry() = default;
+  std::vector<std::unique_ptr<EntryType>> entries_;
+  std::vector<std::string> names_;
+  std::map<std::string, ref_or_own> map_;
+};
+
+/*! \brief convenience base for factory entries (FunctionRegEntryBase). */
+template <typename FunctionType>
+struct FunctionRegEntry {
+  std::string name;
+  std::string description;
+  FunctionType body;
+
+  FunctionRegEntry &set_body(FunctionType f) {
+    body = std::move(f);
+    return *this;
+  }
+  FunctionRegEntry &describe(const std::string &d) {
+    description = d;
+    return *this;
+  }
+};
+
+}  // namespace dmlc_tpu
+
+#endif  // DMLC_TPU_REGISTRY_H_

@@ -27,6 +27,10 @@ class MockS3:
         self.fail_every = fail_every
         self.injected_failures = 0
         self._get_count = 0
+        # when set: the next CompleteMultipartUpload COMMITS server-side but
+        # the response is dropped — the client's retried complete then sees
+        # 404 NoSuchUpload (the real-S3 retry-after-commit hazard)
+        self.fail_complete_once = False
 
     def start(self):
         store = self
@@ -155,7 +159,12 @@ class MockS3:
                     uid = query["uploadId"]
                     part = int(query["partNumber"])
                     with store.lock:
-                        store.uploads[uid]["parts"][part] = body
+                        up = store.uploads.get(uid)
+                        if up is None:
+                            return self._reply(
+                                404, b"<Error><Code>NoSuchUpload</Code>"
+                                     b"</Error>")
+                        up["parts"][part] = body
                     return self._reply(200, b"", {"ETag": f'"part{part}"'})
                 store.objects[(bucket, key)] = body
                 self._reply(200, b"", {"ETag": '"etag"'})
@@ -179,9 +188,23 @@ class MockS3:
                 if "uploadId" in query:
                     uid = query["uploadId"]
                     with store.lock:
-                        up = store.uploads.pop(uid)
+                        up = store.uploads.pop(uid, None)
+                        if up is None:
+                            # completed/aborted upload ids no longer exist
+                            return self._reply(
+                                404, b"<Error><Code>NoSuchUpload</Code>"
+                                     b"</Error>")
                         data = b"".join(v for _, v in sorted(up["parts"].items()))
                         store.objects[up["key"]] = data
+                        drop = store.fail_complete_once
+                        store.fail_complete_once = False
+                    if drop:
+                        # committed, but the client never hears back
+                        import socket as socket_mod
+
+                        self.close_connection = True
+                        self.connection.shutdown(socket_mod.SHUT_RDWR)
+                        return
                     return self._reply(
                         200, b"<CompleteMultipartUploadResult/>")
                 self._reply(400, b"<Error>bad post</Error>")

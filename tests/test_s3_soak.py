@@ -22,7 +22,9 @@ from dmlc_core_tpu.io.stream import create_stream, create_stream_for_read
 
 N_JOBS = 8
 N_REPS = 4
-OBJ_MB = 2
+# parts are clamped to >=5 MiB (DMLC_S3_WRITE_BUFFER_MB floor), so 6 MiB
+# genuinely takes the multipart path: one 5 MiB part + a 1 MiB tail part
+OBJ_MB = 6
 
 
 @pytest.fixture()
@@ -54,10 +56,11 @@ def test_parallel_repeated_cat_with_connection_drops(flaky_s3):
     rng = np.random.RandomState(0)
     payload = rng.bytes(OBJ_MB << 20)
     expected = hashlib.md5(payload).hexdigest()
-    # write through the multipart path (1 MB parts via the env knob)
     with create_stream("s3://dmlc/soak/val.rec", "w") as s:
         for off in range(0, len(payload), 256 * 1024):
             s.write(payload[off:off + 256 * 1024])
+    # the write really went multipart (an upload id was created+consumed)
+    assert flaky_s3.next_upload[0] == 1
     assert flaky_s3.objects[("dmlc", "soak/val.rec")] == payload
 
     results = [[] for _ in range(N_JOBS)]
@@ -114,3 +117,37 @@ def test_retry_exhaustion_raises(flaky_s3, monkeypatch):
     fo = create_stream_for_read("s3://dmlc/dead.bin")
     with pytest.raises(Exception):
         fo.read(100_000)
+
+
+def test_complete_multipart_retry_after_commit_is_success(flaky_s3):
+    """The retry-after-server-side-commit hazard: the complete POST commits
+    but the response is lost; the retried complete gets 404 NoSuchUpload and
+    must verify the object (size-exact) instead of failing the write."""
+    flaky_s3.fail_every = 0                  # only the complete is sabotaged
+    flaky_s3.fail_complete_once = True
+    payload = np.random.RandomState(1).bytes(6 << 20)
+    with create_stream("s3://dmlc/ck/model.bin", "w") as s:
+        s.write(payload)
+    assert flaky_s3.objects[("dmlc", "ck/model.bin")] == payload
+    assert flaky_s3.next_upload[0] == 1      # multipart path taken
+
+
+def test_complete_multipart_lost_upload_fails_loudly(flaky_s3, monkeypatch):
+    """404 on complete with no (or wrong-size) object at the key is a real
+    loss and must raise, even when a stale object sits under the key."""
+    from dmlc_core_tpu.io.s3_filesys import S3FileSystem
+    from dmlc_core_tpu.io import filesys as fsys
+
+    flaky_s3.fail_every = 0
+    # stale object of a DIFFERENT size pre-exists under the key
+    flaky_s3.objects[("dmlc", "ck/stale.bin")] = b"old" * 100
+    fs = fsys.get_filesystem(fsys.URI("s3://dmlc/ck/stale.bin"))
+    stream = fs.open(fsys.URI("s3://dmlc/ck/stale.bin"), "w")
+    # exactly one full part: write() uploads it inline, so close() goes
+    # straight to the complete POST
+    stream.write(np.random.RandomState(2).bytes(5 << 20))
+    # sabotage: the upload vanishes server-side before complete (abort /
+    # lifecycle expiry), so complete 404s and the key holds stale bytes
+    flaky_s3.uploads.clear()
+    with pytest.raises(Exception, match="lost"):
+        stream.close()
