@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""A/B the GBDT hist kernel variants on the attached accelerator.
+
+Times the FULL 10-round fit (the bench.py workload) for each method and
+kernel knob; single-call timings via the tunnel are unreliable (same-input
+dispatches look cached), full-fit wall-clock is stable.
+
+Usage:  python benchmarks/bench_hist_variants.py [rows]
+Knobs:  DMLC_TPU_HIST_I8=0 disables the int8 compare path.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    import jax
+
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+    from dmlc_core_tpu.ops import hist_pallas
+    from dmlc_core_tpu.ops.histogram import apply_bins
+
+    F, NB, D, R = 28, 256, 6, 10
+    rng = np.random.RandomState(0)
+    x = rng.randn(rows, F).astype(np.float32)
+    w = rng.randn(F).astype(np.float32)
+    y = ((x @ w + 0.3 * rng.randn(rows)) > 0).astype(np.float32)
+    param = GBDTParam(num_boost_round=R, max_depth=D, num_bins=NB,
+                      learning_rate=0.3)
+    model = GBDT(param, num_feature=F)
+    model.make_bins(x[:50_000])
+    bins = np.asarray(apply_bins(x, model.boundaries)).astype(np.int32)
+    dev = jax.devices()[0]
+    ones = np.ones(rows, np.float32)
+    print(f"device: {dev}  rows={rows}  "
+          f"i8_supported={hist_pallas.pallas_i8_supported()}")
+
+    def fit_time(method):
+        fit = model._fit_fn(R, method)
+        b = jax.device_put(bins, dev)
+        yy = jax.device_put(y, dev)
+        ww = jax.device_put(ones, dev)
+        _, m = fit(b, yy, ww)
+        jax.block_until_ready(m)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _, m = fit(b, yy, ww)
+            jax.block_until_ready(m)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    for method in ("pallas", "pallas_fused", "onehot"):
+        dt = fit_time(method)
+        print(f"{method:13s}: {dt * 1e3:7.1f} ms  "
+              f"{rows * R / dt / 1e6:6.2f}M rows/s")
+        # fresh compilation caches per method set are keyed by method only;
+        # the i8 knob changes traced dtypes, so re-jit happens naturally
+        model._fit_fn.cache_clear()
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("BENCH", "1")
+    main()

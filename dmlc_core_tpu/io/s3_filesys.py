@@ -251,8 +251,11 @@ class S3WriteStream(Stream):
         # CompleteMultipartUpload is the one non-idempotent call: if a
         # transport retry re-sends it after S3 already committed, S3 answers
         # 404 NoSuchUpload.  Accept the 404 only when the object at the key
-        # has exactly the bytes we uploaded — a bare existence check would
-        # mistake a stale object under an overwritten key for success.
+        # is provably THIS upload: the multipart ETag is derivable from the
+        # collected part ETags (md5 of concatenated part-md5s, "-N" suffix),
+        # which distinguishes our bytes from a stale same-size object under
+        # an overwritten key (the fixed-shape checkpoint case).  Size is the
+        # fallback when the store returns non-standard part ETags.
         status, _, _ = self._client.request(
             "POST", self._key, query={"uploadId": self._upload_id},
             body=body, ok=(200, 404))
@@ -262,10 +265,25 @@ class S3WriteStream(Stream):
             landed = (hs == 200 and
                       int(headers.get("content-length", -1))
                       == self._total_bytes)
+            expected = self._multipart_etag()
+            if landed and expected is not None:
+                landed = headers.get("etag", "").strip('"') == expected
             CHECK(landed,
                   f"multipart upload of {self._key} lost: complete returned "
-                  f"NoSuchUpload and the object is missing or has the wrong "
-                  f"size (expected {self._total_bytes} bytes)")
+                  f"NoSuchUpload and the object at the key is missing or is "
+                  f"not this upload (expected {self._total_bytes} bytes, "
+                  f"etag {expected})")
+
+    def _multipart_etag(self) -> Optional[str]:
+        """The ETag S3 assigns a completed multipart upload, from the part
+        ETags we collected — or None when parts carried non-md5 tags."""
+        try:
+            digest = hashlib.md5(
+                b"".join(bytes.fromhex(e.strip('"')) for e in self._etags)
+            ).hexdigest()
+        except ValueError:
+            return None
+        return f"{digest}-{len(self._etags)}"
 
     def __del__(self):
         try:

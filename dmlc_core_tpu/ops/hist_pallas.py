@@ -34,7 +34,7 @@ import numpy as np
 __all__ = ["hist_matmul_pallas", "grad_hist_pallas",
            "grad_hist_pallas_fused", "grad_hist_pallas_sharded",
            "ambient_mesh", "sharded_hist_plan", "pallas_supported",
-           "pallas_fused_supported", "hist_fits_vmem",
+           "pallas_fused_supported", "pallas_i8_supported", "hist_fits_vmem",
            "BLOCK_ROWS", "DATA_AXIS"]
 
 # interpreter mode: runs the kernels on CPU for tests/debugging (flipped by
@@ -47,6 +47,16 @@ _INTERPRET = _os.environ.get("DMLC_TPU_PALLAS_INTERPRET",
 # row-tile size: callers that want the wrapper's internal padding to no-op
 # (e.g. GBDT's fit-level padding) must pad to a multiple of this
 BLOCK_ROWS = 1024
+
+
+def _bins_compare_dtype(num_bins: int):
+    """dtype bins are compared in inside the kernel: int8 when the bin ids
+    fit (<=256 with wraparound) AND the backend lowers it, else int32."""
+    import jax.numpy as jnp
+
+    if num_bins <= 256 and pallas_i8_supported():
+        return jnp.int8
+    return jnp.int32
 
 # VMEM budget for the resident accumulator block (bytes); above this
 # callers fall back to the plain one-hot matmul.
@@ -66,7 +76,14 @@ def hist_fits_vmem(num_nodes: int, num_feature: int, num_bins: int) -> bool:
 
 def _accumulate_tile(w, bins_ref, out_ref, num_feature: int, num_bins: int):
     """Shared tile body: zero-init at step 0, then per-feature one-hot dots
-    of ``w`` [M, TB] accumulated into the resident ``out_ref``."""
+    of ``w`` [M, TB] accumulated into the resident ``out_ref``.
+
+    The iota matches the bins dtype: callers may pass bins as int8 (the
+    profiled v5e bottleneck is this in-VMEM one-hot build, not the MXU dots
+    — kernel time is m-independent — and int8 compares run 4 lanes/cycle
+    wider on the VPU).  num_bins=256 still fits: both sides wrap through
+    int8 identically, so equality is preserved.
+    """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -76,6 +93,7 @@ def _accumulate_tile(w, bins_ref, out_ref, num_feature: int, num_bins: int):
         out_ref[:] = jnp.zeros_like(out_ref)
 
     iota = jax.lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
+    iota = iota.astype(bins_ref.dtype)
     for f in range(num_feature):
         onehot = (bins_ref[:, f:f + 1] == iota).astype(w.dtype)  # [TB, nbins]
         out_ref[:, f * num_bins:(f + 1) * num_bins] += jax.lax.dot_general(
@@ -113,6 +131,7 @@ def hist_matmul_pallas(w, bins, num_bins: int, block_rows: int = BLOCK_ROWS):
 
     m, b = w.shape
     bf = bins.shape[1]
+    bins = bins.astype(_bins_compare_dtype(num_bins))
     if b % block_rows:
         pad = block_rows - b % block_rows
         w = jnp.pad(w, ((0, 0), (0, pad)))         # zero W => zero contribution
@@ -184,7 +203,7 @@ def grad_hist_pallas_fused(bins, node_ids, grad, hess, num_nodes: int,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bins = jnp.asarray(bins).astype(jnp.int32)
+    bins = jnp.asarray(bins).astype(_bins_compare_dtype(num_bins))
     b, bf = bins.shape
     n_pad = _pad_nodes(num_nodes)
     node = node_ids.astype(jnp.int32).reshape(1, b)
@@ -333,6 +352,46 @@ def pallas_supported() -> bool:
         bins = jnp.zeros((128, 2), jnp.int32)
         out = jax.jit(lambda w, b: hist_matmul_pallas(w, b, 8,
                                                       block_rows=128))(w, bins)
+        return bool(np.asarray(out)[0, 0] == 1.0)
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def pallas_i8_supported() -> bool:
+    """Probe whether int8 bins compare+select lowers in the kernel.
+
+    Probed with a direct pallas_call (not through the wrappers, which would
+    recurse into this gate): an int8 bins tile against the shared tile body.
+    Falls back to int32 bins when Mosaic rejects the int8 vector ops, and is
+    disabled outright by DMLC_TPU_HIST_I8=0 for A/B benchmarking.
+    """
+    if _os.environ.get("DMLC_TPU_HIST_I8", "").strip() == "0":
+        return False
+    import jax
+
+    if jax.default_backend() == "cpu" and not _INTERPRET:
+        return False
+    try:
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        kernel = functools.partial(_kernel, num_feature=2, num_bins=8)
+        w = jnp.zeros((16, 128), jnp.bfloat16).at[0, 0].set(1.0)
+        bins = jnp.zeros((128, 2), jnp.int8)
+        out = jax.jit(lambda w, b: pl.pallas_call(
+            kernel,
+            grid=(1,),
+            in_specs=[pl.BlockSpec((16, 128), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((128, 2), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((16, 16), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((16, 16), jnp.float32),
+            interpret=_INTERPRET,
+        )(w, b))(w, bins)
         return bool(np.asarray(out)[0, 0] == 1.0)
     except Exception:
         return False

@@ -9,6 +9,7 @@
 // Run: ./demo <file.libsvm> <nparts>
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <map>

@@ -9,6 +9,7 @@ SigV4 Authorization header.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -17,6 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 class MockS3:
     def __init__(self, fail_every: int = 0):
         self.objects = {}      # (bucket, key) -> bytes
+        self.etags = {}        # (bucket, key) -> etag (no quotes)
         self.uploads = {}      # upload_id -> {"key":..., "parts": {n: bytes}}
         self.next_upload = [0]
         self.lock = threading.Lock()
@@ -76,7 +78,10 @@ class MockS3:
                 if data is None:
                     self._reply(404)
                 else:
-                    self._reply(200, b"", {"Content-Length": str(len(data))})
+                    etag = store.etags.get(
+                        (bucket, key), hashlib.md5(data).hexdigest())
+                    self._reply(200, b"", {"Content-Length": str(len(data)),
+                                           "ETag": f'"{etag}"'})
                     return
 
             def _should_fail(self):
@@ -165,8 +170,13 @@ class MockS3:
                                 404, b"<Error><Code>NoSuchUpload</Code>"
                                      b"</Error>")
                         up["parts"][part] = body
-                    return self._reply(200, b"", {"ETag": f'"part{part}"'})
+                    # real S3 part ETags are the part body's md5 — the
+                    # client derives the multipart object ETag from them
+                    return self._reply(
+                        200, b"",
+                        {"ETag": f'"{hashlib.md5(body).hexdigest()}"'})
                 store.objects[(bucket, key)] = body
+                store.etags[(bucket, key)] = hashlib.md5(body).hexdigest()
                 self._reply(200, b"", {"ETag": '"etag"'})
 
             def do_POST(self):
@@ -194,8 +204,13 @@ class MockS3:
                             return self._reply(
                                 404, b"<Error><Code>NoSuchUpload</Code>"
                                      b"</Error>")
-                        data = b"".join(v for _, v in sorted(up["parts"].items()))
+                        parts = [v for _, v in sorted(up["parts"].items())]
+                        data = b"".join(parts)
                         store.objects[up["key"]] = data
+                        store.etags[up["key"]] = (
+                            hashlib.md5(b"".join(
+                                hashlib.md5(p).digest() for p in parts)
+                            ).hexdigest() + f"-{len(parts)}")
                         drop = store.fail_complete_once
                         store.fail_complete_once = False
                     if drop:

@@ -17,10 +17,12 @@ def interpret_mode():
     hist_pallas._INTERPRET = True
     hist_pallas.pallas_supported.cache_clear()
     hist_pallas.pallas_fused_supported.cache_clear()
+    hist_pallas.pallas_i8_supported.cache_clear()
     yield
     hist_pallas._INTERPRET = False
     hist_pallas.pallas_supported.cache_clear()
     hist_pallas.pallas_fused_supported.cache_clear()
+    hist_pallas.pallas_i8_supported.cache_clear()
 
 
 def _rand_case(b, f, nbins, nnodes, seed=0):
@@ -291,3 +293,42 @@ def test_ambient_mesh_probe_on_current_jax():
         assert hist_pallas.sharded_hist_plan("model", 8, 4, 16,
                                              batch=256) is m
     assert hist_pallas.ambient_mesh() is None
+
+
+@pytest.mark.parametrize("nbins", [256, 257])
+def test_i8_compare_dtype_gate(nbins):
+    """int8 bins compares apply exactly when bin ids fit 256 (wraparound
+    keeps equality a bijection); wider binnings stay int32."""
+    import jax.numpy as jnp
+
+    dt = hist_pallas._bins_compare_dtype(nbins)
+    if nbins <= 256:
+        assert dt == (jnp.int8 if hist_pallas.pallas_i8_supported()
+                      else jnp.int32)
+    else:
+        assert dt == jnp.int32
+
+
+def test_i8_path_matches_scatter_at_256_bins(monkeypatch):
+    """Full 256-bin case through the int8 compare path (bin 255 wraps to -1
+    in int8 on both sides of the compare)."""
+    monkeypatch.delenv("DMLC_TPU_HIST_I8", raising=False)
+    hist_pallas.pallas_i8_supported.cache_clear()
+    assert hist_pallas.pallas_i8_supported()   # interpret mode lowers it
+    bins, node, g, h = _rand_case(512, 3, 256, 4, seed=21)
+    bins[:16, 0] = 255                          # exercise the wrap edge
+    G, H = hist_pallas.grad_hist_pallas(bins, node, g, h, 4, 256)
+    Gr, Hr = grad_histogram(bins, node, g, h, 4, 256, method="scatter")
+    np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(H), np.asarray(Hr),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_i8_disable_env(monkeypatch):
+    monkeypatch.setenv("DMLC_TPU_HIST_I8", "0")
+    hist_pallas.pallas_i8_supported.cache_clear()
+    try:
+        assert not hist_pallas.pallas_i8_supported()
+    finally:
+        hist_pallas.pallas_i8_supported.cache_clear()

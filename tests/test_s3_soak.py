@@ -151,3 +151,23 @@ def test_complete_multipart_lost_upload_fails_loudly(flaky_s3, monkeypatch):
     flaky_s3.uploads.clear()
     with pytest.raises(Exception, match="lost"):
         stream.close()
+
+
+def test_complete_multipart_same_size_stale_object_still_fails(flaky_s3):
+    """Fixed-shape checkpoints overwrite the same key with the same byte
+    count every round: a lost upload must not pass verification just because
+    a same-size previous-round object sits at the key (ETag distinguishes)."""
+    from dmlc_core_tpu.io import filesys as fsys
+
+    flaky_s3.fail_every = 0
+    size = 5 << 20
+    stale = np.random.RandomState(3).bytes(size)
+    flaky_s3.objects[("dmlc", "ck/fixed.bin")] = stale
+    fs = fsys.get_filesystem(fsys.URI("s3://dmlc/ck/fixed.bin"))
+    stream = fs.open(fsys.URI("s3://dmlc/ck/fixed.bin"), "w")
+    stream.write(np.random.RandomState(4).bytes(size))   # same size
+    flaky_s3.uploads.clear()                              # upload lost
+    with pytest.raises(Exception, match="lost"):
+        stream.close()
+    # the stale object was not clobbered or blessed
+    assert flaky_s3.objects[("dmlc", "ck/fixed.bin")] == stale
