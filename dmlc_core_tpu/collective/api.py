@@ -14,9 +14,11 @@ the same script works from a laptop to a pod (the reference's local-vs-cluster
 symmetry).
 
 Env contract (set by dmlc_core_tpu.tracker launchers, reference tracker.py):
-``DMLC_TASK_ID`` → process id, ``DMLC_NUM_WORKER`` → world size,
-``DMLC_COORDINATOR_URI``/``DMLC_COORDINATOR_PORT`` → jax.distributed
-coordinator address.
+``DMLC_TASK_ID`` → process id (falling back to the launcher rank vars
+``OMPI_COMM_WORLD_RANK``/``PMIX_RANK``/``PMI_RANK``/``SLURM_PROCID`` — the
+mpi backend cannot bake per-rank ids into mpirun's shared environment),
+``DMLC_NUM_WORKER`` → world size, ``DMLC_COORDINATOR_URI``/
+``DMLC_COORDINATOR_PORT`` → jax.distributed coordinator address.
 """
 
 from __future__ import annotations
@@ -59,6 +61,25 @@ _state: dict = {
 _OPS = {"sum": np.add, "max": np.maximum, "min": np.minimum, "prod": np.multiply}
 
 
+def _task_id_from_env(env) -> int:
+    """Process id for jax.distributed: DMLC_TASK_ID when the launcher set it
+    (local/ssh/sge/yarn backends), else the MPI/SLURM launcher's rank var —
+    mpirun assigns ranks itself, so the mpi backend cannot bake per-process
+    task ids into the (shared) environment (reference rabit got its rank
+    from tracker rendezvous instead; jax.distributed needs it up front)."""
+    for key in ("DMLC_TASK_ID", "OMPI_COMM_WORLD_RANK", "PMIX_RANK",
+                "PMI_RANK", "SLURM_PROCID"):
+        value = env.get(key, "").strip()
+        if value:
+            try:
+                return int(value)
+            except ValueError:
+                # stale/garbage launcher vars inherited by an unrelated run
+                # must not break standalone init
+                log_info(f"ignoring non-integer {key}={value!r}")
+    return 0
+
+
 def init(args: Optional[dict] = None) -> None:
     """Initialize the collective runtime (rabit::Init equivalent).
 
@@ -74,7 +95,7 @@ def init(args: Optional[dict] = None) -> None:
     if args:
         env.update({k: str(v) for k, v in args.items()})
     num_worker = int(env.get("DMLC_NUM_WORKER", "1"))
-    task_id = int(env.get("DMLC_TASK_ID", "0"))
+    task_id = _task_id_from_env(env)
     coord_uri = env.get("DMLC_COORDINATOR_URI", "")
     coord_port = env.get("DMLC_COORDINATOR_PORT", "")
     if num_worker > 1 and coord_uri:

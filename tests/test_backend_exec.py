@@ -1,0 +1,198 @@
+"""Execution tests for the ssh / mpi / sge launcher backends.
+
+The reference's tracker had zero tests; SURVEY §4 commits to exceeding that.
+The local backend has real e2e coverage (test_tracker.py); here the other
+launchers run end-to-end against fake cluster binaries on PATH:
+
+- ``ssh``   — consumes the option flags and runs the remote command locally
+  through ``sh -c`` (what sshd would do on the far side);
+- ``mpirun``— parses -n/-x like OpenMPI, then spawns N local processes with
+  OMPI_COMM_WORLD_RANK set (exactly the env a real OpenMPI gives ranks);
+- ``qsub``  — parses the array-job spec and runs each task with SGE_TASK_ID.
+
+Workers are real processes doing a real jax.distributed collective, so the
+whole path — env contract assembly, command quoting, per-task identity,
+coordinator rendezvous — is executed, not just string-asserted.
+"""
+
+import os
+import stat
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dmlc_core_tpu.tracker.opts import get_opts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# real collective worker (same shape as test_tracker.py's WORKER_SCRIPT)
+WORKER = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dmlc_core_tpu import collective
+
+collective.init()
+rank = collective.get_rank()
+world = collective.get_world_size()
+out = collective.allreduce(np.array([float(rank + 1)], dtype=np.float32))
+assert abs(float(out[0]) - world * (world + 1) / 2) < 1e-5
+with open(os.environ["RESULT_DIR"] + f"/rank{rank}.ok", "w") as f:
+    f.write(os.environ.get("WORKER_VIA", "?"))
+collective.finalize()
+"""
+
+FAKE_SSH = """#!/bin/sh
+# fake sshd: swallow ssh options, then run the remote command locally
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -o|-p) shift 2 ;;
+    *) break ;;
+  esac
+done
+host="$1"; shift
+WORKER_VIA="ssh:$host" ; export WORKER_VIA
+exec sh -c "$*"
+"""
+
+FAKE_MPIRUN = """#!/usr/bin/env python3
+import os, subprocess, sys
+args = sys.argv[1:]
+if "--version" in args:
+    print("mpirun (Open MPI) 9.fake")
+    sys.exit(0)
+n, env, cmd, i = 1, {}, [], 0
+while i < len(args):
+    a = args[i]
+    if a == "-n":
+        n = int(args[i + 1]); i += 2
+    elif a == "--hostfile":
+        i += 2
+    elif a == "-x":
+        k, _, v = args[i + 1].partition("="); env[k] = v; i += 2
+    else:
+        cmd = args[i:]; break
+procs = []
+for r in range(n):
+    e = os.environ.copy(); e.update(env)
+    e["OMPI_COMM_WORLD_RANK"] = str(r)
+    e["OMPI_COMM_WORLD_SIZE"] = str(n)
+    e["WORKER_VIA"] = "mpi"
+    procs.append(subprocess.Popen(cmd, env=e))
+sys.exit(max([p.wait() for p in procs], default=0))
+"""
+
+FAKE_QSUB = """#!/usr/bin/env python3
+import os, subprocess, sys
+args = sys.argv[1:]
+lo = hi = 1
+script = args[-1]
+for i, a in enumerate(args):
+    if a == "-t":
+        lo, hi = (int(x) for x in args[i + 1].split("-"))
+procs = []
+for t in range(lo, hi + 1):
+    e = os.environ.copy()
+    e["SGE_TASK_ID"] = str(t)
+    e["WORKER_VIA"] = "sge"
+    procs.append(subprocess.Popen(["/bin/bash", script], env=e))
+sys.exit(max([p.wait() for p in procs], default=0))
+"""
+
+
+@pytest.fixture()
+def fake_cluster(tmp_path, monkeypatch):
+    """Fake cluster binaries on PATH + a worker script + no_wait submit."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    for name, body in (("ssh", FAKE_SSH), ("mpirun", FAKE_MPIRUN),
+                       ("qsub", FAKE_QSUB)):
+        p = bindir / name
+        p.write_text(body)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("RESULT_DIR", str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("PYTHONPATH",
+                       REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    return tmp_path, worker
+
+
+def _no_wait_submit(module, monkeypatch):
+    from dmlc_core_tpu.tracker import submit as submit_mod
+
+    orig = submit_mod.submit_job
+
+    def no_wait(opts_, fun, wait=True):
+        return orig(opts_, fun, wait=False)
+
+    monkeypatch.setattr(module, "submit_job", no_wait)
+
+
+def _assert_ranks(tmp_path, n, via):
+    for r in range(n):
+        f = tmp_path / f"rank{r}.ok"
+        assert f.exists(), f"rank {r} never completed (via {via})"
+        assert f.read_text().startswith(via)
+
+
+def test_ssh_backend_executes_workers(fake_cluster, monkeypatch):
+    tmp_path, worker = fake_cluster
+    hostfile = tmp_path / "hosts"
+    hostfile.write_text("nodeA\nnodeB:2222\n")
+    from dmlc_core_tpu.tracker import ssh
+
+    opts = get_opts(["--cluster", "ssh", "--num-workers", "2",
+                     "--host-file", str(hostfile), "--",
+                     sys.executable, str(worker)])
+    ssh.submit(opts)
+    _assert_ranks(tmp_path, 2, "ssh")
+    # round-robin host assignment reached both hosts
+    seen = {(tmp_path / f"rank{r}.ok").read_text() for r in range(2)}
+    assert seen == {"ssh:nodeA", "ssh:nodeB"}
+
+
+def test_mpi_backend_executes_workers(fake_cluster, monkeypatch):
+    tmp_path, worker = fake_cluster
+    from dmlc_core_tpu.tracker import mpi
+
+    opts = get_opts(["--cluster", "mpi", "--num-workers", "2", "--",
+                     sys.executable, str(worker)])
+    mpi.submit(opts)
+    # ranks derived from OMPI_COMM_WORLD_RANK (no DMLC_TASK_ID under mpirun)
+    _assert_ranks(tmp_path, 2, "mpi")
+
+
+def test_sge_backend_executes_workers(fake_cluster, monkeypatch, tmp_path):
+    work, worker = fake_cluster
+    from dmlc_core_tpu.tracker import sge
+
+    _no_wait_submit(sge, monkeypatch)   # workers are not rabit clients
+    monkeypatch.chdir(work)
+    opts = get_opts(["--cluster", "sge", "--num-workers", "2",
+                     "--jobname", "sgejob", "--",
+                     sys.executable, str(worker)])
+    sge.submit(opts)
+    _assert_ranks(work, 2, "sge")
+    assert (work / "sgejob.sge.sh").exists()
+
+
+def test_task_id_env_fallback_ignores_garbage():
+    from dmlc_core_tpu.collective.api import _task_id_from_env
+
+    assert _task_id_from_env({"DMLC_TASK_ID": "3"}) == 3
+    assert _task_id_from_env({"OMPI_COMM_WORLD_RANK": "2"}) == 2
+    # DMLC_TASK_ID wins over launcher vars
+    assert _task_id_from_env({"DMLC_TASK_ID": "1",
+                              "OMPI_COMM_WORLD_RANK": "7"}) == 1
+    # stale/garbage inherited vars must not break standalone init
+    assert _task_id_from_env({"PMI_RANK": ""}) == 0
+    assert _task_id_from_env({"SLURM_PROCID": "garbage"}) == 0
+    assert _task_id_from_env({"PMI_RANK": "x", "SLURM_PROCID": "4"}) == 4
