@@ -107,14 +107,17 @@ def submit(opts) -> None:
 
         for i in range(opts.num_servers + opts.num_workers):
             env = dict(envs)
-            env["DMLC_TASK_ID"] = str(i)
+            # task ids are role-relative (workers 0..nw-1): DMLC_TASK_ID is
+            # the collective's process id, same split as ssh.py/sge.py
             if i < opts.num_servers:
                 env["DMLC_ROLE"] = "server"
+                env["DMLC_TASK_ID"] = str(i)
                 env["DMLC_SERVER_ID"] = str(i)
                 resources = {"cpus": float(opts.server_cores),
                              "mem": float(opts.server_memory_mb)}
             else:
                 env["DMLC_ROLE"] = "worker"
+                env["DMLC_TASK_ID"] = str(i - opts.num_servers)
                 env["DMLC_WORKER_ID"] = str(i - opts.num_servers)
                 resources = {"cpus": float(opts.worker_cores),
                              "mem": float(opts.worker_memory_mb)}

@@ -12,6 +12,7 @@ import stat
 import subprocess
 from typing import Dict
 
+from dmlc_core_tpu.tracker.ssh import _shquote
 from dmlc_core_tpu.tracker.submit import submit_job
 
 __all__ = ["submit"]
@@ -25,13 +26,20 @@ def submit(opts) -> None:
         with open(runscript, "w") as f:
             f.write("#!/bin/bash\n#$ -S /bin/bash\n")
             f.write(f"#$ -q {opts.queue}\n")
-            f.write("export DMLC_TASK_ID=$((SGE_TASK_ID - 1))\n")
+            f.write("GLOBAL_ID=$((SGE_TASK_ID - 1))\n")
             for k, v in envs.items():
-                f.write(f"export {k}={v}\n")
-            f.write('if [ "$DMLC_TASK_ID" -lt "%d" ]; then\n'
-                    '  export DMLC_ROLE=server\nelse\n'
-                    '  export DMLC_ROLE=worker\nfi\n' % opts.num_servers)
-            f.write(" ".join(opts.command) + "\n")
+                f.write(f"export {k}={_shquote(v)}\n")
+            # task ids are role-relative (workers 0..nw-1, servers 0..ns-1):
+            # DMLC_TASK_ID is the collective's process id, so a server
+            # offset would corrupt worker rank identity (ssh.py computes
+            # the same split)
+            f.write('if [ "$GLOBAL_ID" -lt "%d" ]; then\n'
+                    '  export DMLC_ROLE=server\n'
+                    '  export DMLC_TASK_ID=$GLOBAL_ID\nelse\n'
+                    '  export DMLC_ROLE=worker\n'
+                    '  export DMLC_TASK_ID=$((GLOBAL_ID - %d))\nfi\n'
+                    % (opts.num_servers, opts.num_servers))
+            f.write(" ".join(map(_shquote, opts.command)) + "\n")
         os.chmod(runscript, os.stat(runscript).st_mode | stat.S_IEXEC)
         n = opts.num_workers + opts.num_servers
         cmd = ["qsub", "-cwd", "-t", f"1-{n}",

@@ -27,7 +27,8 @@ import threading
 from typing import Dict
 
 from dmlc_core_tpu.tracker.submit import submit_job
-from dmlc_core_tpu.tracker.ssh import FORWARD_ENV, _shquote, parse_host_file
+from dmlc_core_tpu.tracker.ssh import (FORWARD_ENV, _shquote, _ssh_command,
+                                       parse_host_file)
 
 __all__ = ["submit"]
 
@@ -39,6 +40,10 @@ def _gcloud_cmd(env: Dict[str, str], command) -> list:
     zone = os.environ.get("TPU_ZONE", "")
     assert tpu_name, "tpu-vm backend needs --host-file or TPU_NAME env"
     exports = "; ".join(f"export {k}={_shquote(v)}" for k, v in env.items())
+    # the per-host task id MUST expand on the remote host (every host gets
+    # the same command line; only TPU_WORKER_ID differs there) — a quoted
+    # literal would give every host process id 0 and deadlock rendezvous
+    exports += '; export DMLC_TASK_ID="${TPU_WORKER_ID:-0}"'
     remote = f"{exports}; {' '.join(map(_shquote, command))}"
     cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
            "--worker=all", f"--command={remote}"]
@@ -63,13 +68,8 @@ def submit(opts) -> None:
                 env = dict(base_env)
                 env["DMLC_ROLE"] = "worker"
                 env["DMLC_TASK_ID"] = str(taskid)
-                exports = "; ".join(
-                    f"export {k}={_shquote(v)}" for k, v in env.items())
-                workdir = opts.sync_dst_dir or "."
-                remote = (f"{exports}; cd {_shquote(workdir)}; "
-                          f"exec {' '.join(map(_shquote, opts.command))}")
-                cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-p",
-                       str(port), host, remote]
+                cmd = _ssh_command(host, port, env,
+                                   opts.sync_dst_dir or ".", opts.command)
                 t = threading.Thread(target=subprocess.check_call, args=(cmd,),
                                      daemon=True)
                 t.start()
@@ -78,10 +78,10 @@ def submit(opts) -> None:
                 t.join()
         else:
             # gcloud path: the TPU runtime provides per-host task ids via
-            # TPU_WORKER_ID; DMLC_TASK_ID defers to it on each host.
+            # TPU_WORKER_ID; _gcloud_cmd emits the (unquoted, host-side)
+            # DMLC_TASK_ID export itself.
             env = dict(base_env)
             env["DMLC_ROLE"] = "worker"
-            env["DMLC_TASK_ID"] = "${TPU_WORKER_ID:-0}"
             subprocess.check_call(_gcloud_cmd(env, opts.command))
 
     submit_job(opts, fun_submit, wait=False)

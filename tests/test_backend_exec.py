@@ -196,3 +196,76 @@ def test_task_id_env_fallback_ignores_garbage():
     assert _task_id_from_env({"PMI_RANK": ""}) == 0
     assert _task_id_from_env({"SLURM_PROCID": "garbage"}) == 0
     assert _task_id_from_env({"PMI_RANK": "x", "SLURM_PROCID": "4"}) == 4
+
+
+def test_sge_task_ids_are_role_relative(fake_cluster, monkeypatch):
+    """With servers in the job, worker DMLC_TASK_IDs must still be
+    0..nw-1 (they are the collective's process ids)."""
+    work, _ = fake_cluster
+    from dmlc_core_tpu.tracker import sge
+
+    _no_wait_submit(sge, monkeypatch)
+    monkeypatch.chdir(work)
+    probe = work / "probe.py"
+    probe.write_text(
+        "import os\n"
+        "role = os.environ['DMLC_ROLE']\n"
+        "tid = os.environ['DMLC_TASK_ID']\n"
+        "open(os.environ['RESULT_DIR'] + f'/{role}{tid}.seen', 'w').close()\n")
+    opts = get_opts(["--cluster", "sge", "--num-workers", "2",
+                     "--num-servers", "1", "--jobname", "rolejob", "--",
+                     sys.executable, str(probe)])
+    sge.submit(opts)
+    assert (work / "server0.seen").exists()
+    assert (work / "worker0.seen").exists()
+    assert (work / "worker1.seen").exists()
+
+
+FAKE_GCLOUD = """#!/usr/bin/env python3
+# fake `gcloud compute tpus tpu-vm ssh NAME --worker=all --command=...`:
+# run the command once per "host" with TPU_WORKER_ID set, like the real
+# per-host agent environment.
+import os, subprocess, sys
+cmd = None
+for a in sys.argv[1:]:
+    if a.startswith("--command="):
+        cmd = a[len("--command="):]
+assert cmd, sys.argv
+n = int(os.environ.get("FAKE_TPU_HOSTS", "2"))
+procs = []
+for w in range(n):
+    e = os.environ.copy()
+    e["TPU_WORKER_ID"] = str(w)
+    e["WORKER_VIA"] = "tpu-vm"
+    procs.append(subprocess.Popen(["/bin/sh", "-c", cmd], env=e))
+sys.exit(max([p.wait() for p in procs], default=0))
+"""
+
+
+def test_tpu_vm_backend_hostfile_path(fake_cluster, monkeypatch):
+    tmp_path, worker = fake_cluster
+    hostfile = tmp_path / "tpu_hosts"
+    hostfile.write_text("tpu-w0\ntpu-w1\n")
+    from dmlc_core_tpu.tracker import tpu_vm
+
+    opts = get_opts(["--cluster", "tpu-vm", "--num-workers", "2",
+                     "--host-file", str(hostfile), "--",
+                     sys.executable, str(worker)])
+    tpu_vm.submit(opts)
+    _assert_ranks(tmp_path, 2, "ssh")   # rides the ssh machinery
+
+
+def test_tpu_vm_backend_gcloud_path(fake_cluster, monkeypatch):
+    tmp_path, worker = fake_cluster
+    gcloud = tmp_path / "bin" / "gcloud"
+    gcloud.write_text(FAKE_GCLOUD)
+    gcloud.chmod(gcloud.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("TPU_NAME", "fake-slice")
+    monkeypatch.setenv("FAKE_TPU_HOSTS", "2")
+    from dmlc_core_tpu.tracker import tpu_vm
+
+    opts = get_opts(["--cluster", "tpu-vm", "--num-workers", "2", "--",
+                     sys.executable, str(worker)])
+    tpu_vm.submit(opts)
+    # per-host identity came from TPU_WORKER_ID through the env contract
+    _assert_ranks(tmp_path, 2, "tpu-vm")

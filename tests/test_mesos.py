@@ -63,8 +63,10 @@ def test_mesos_submit_tasks(monkeypatch):
 
     roles = sorted(env["DMLC_ROLE"] for _, _, env, _ in launched)
     assert roles == ["server", "worker", "worker"]
-    task_ids = sorted(env["DMLC_TASK_ID"] for _, _, env, _ in launched)
-    assert task_ids == ["0", "1", "2"]
+    # task ids are role-relative: they are the collective's process ids
+    by_role = sorted((env["DMLC_ROLE"], env["DMLC_TASK_ID"])
+                     for _, _, env, _ in launched)
+    assert by_role == [("server", "0"), ("worker", "0"), ("worker", "1")]
     for master, prog, env, resources in launched:
         assert master == "master-host:5050"
         assert prog == "python train.py"
