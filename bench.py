@@ -106,6 +106,16 @@ def time_fit(model, bins, y, rounds, device, method):
     return len(y) * rounds / elapsed, elapsed, acc
 
 
+def _i8_state() -> bool:
+    """Whether the hist kernel ran int8 one-hot compares (probe-gated)."""
+    try:
+        from dmlc_core_tpu.ops.hist_pallas import pallas_i8_supported
+
+        return bool(pallas_i8_supported())
+    except Exception:
+        return False
+
+
 def run_probe():
     """Child body: report which platform jax.devices() lands on."""
     import jax
@@ -168,6 +178,7 @@ def run_bench(force_cpu):
         "detail": {
             "device": str(accel),
             "hist_method": accel_method,
+            "hist_i8_compares": _i8_state(),
             "rounds": accel_rounds,
             "seconds": round(accel_s, 3),
             "cpu_rows_per_sec": round(cpu_rps, 1),
