@@ -536,6 +536,10 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         total = self._file_offset[-1]
         for a, b in zip(offsets, offsets[1:] + [total]):
             self._index.append((a, b - a))
+        # array mirror of _index for bulk plan construction (shuffle epochs
+        # index one span per record; per-tuple Python loops would pay ~the
+        # cost of a small read per epoch at millions of records)
+        self._index_arr = np.asarray(self._index, dtype=np.int64)
 
     # record-count-based partitioning (reference .cc:12-41)
     def reset_partition(self, part_index: int, num_parts: int) -> None:
@@ -609,32 +613,27 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
 
     def _epoch_plan(self):
         """(offsets, sizes, batch counts) for one epoch of batch reads."""
-        offs: List[int] = []
-        szs: List[int] = []
-        counts: List[int] = []
         bs = self._batch_size
         if self._offset_begin >= self._offset_end:
-            return offs, szs, counts
+            return [], [], []
         if self._shuffle:
-            for j0 in range(0, len(self._permutation), bs):
-                group = self._permutation[j0:j0 + bs]
-                for j in group:
-                    off, size = self._index[j]
-                    offs.append(off)
-                    szs.append(size)
-                counts.append(len(group))
-        else:
-            i = self._index_begin
-            while i < self._index_end:
-                last = min(i + bs, self._index_end)
-                begin_off = self._index[i][0]
-                end_off = (self._offset_end if last == self._index_end
-                           else self._index[last][0])
-                offs.append(begin_off)
-                szs.append(end_off - begin_off)
-                counts.append(1)
-                i = last
-        return offs, szs, counts
+            # one span per record, numpy-gathered from the index mirror
+            perm = np.asarray(self._permutation, dtype=np.int64)
+            spans = self._index_arr[perm]               # [n, 2] (off, size)
+            n = len(perm)
+            counts = np.full(-(-n // bs), bs, dtype=np.int64)
+            if n % bs:
+                counts[-1] = n % bs
+            return spans[:, 0], spans[:, 1], counts
+        # contiguous batches: one span per batch
+        heads = np.arange(self._index_begin, self._index_end, bs,
+                          dtype=np.int64)
+        lasts = np.minimum(heads + bs, self._index_end)
+        offs = self._index_arr[heads, 0]
+        ends = np.where(lasts == self._index_end, self._offset_end,
+                        self._index_arr[np.minimum(lasts,
+                                                   len(self._index) - 1), 0])
+        return offs, ends - offs, np.ones(len(heads), dtype=np.int64)
 
     def _resync_from_native(self) -> None:
         """Abandon the native plan (batch size changed mid-epoch): restore

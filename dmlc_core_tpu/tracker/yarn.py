@@ -28,10 +28,9 @@ import json
 import logging
 import os
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List
-
-import urllib.error
 
 from dmlc_core_tpu.tracker.submit import submit_job
 from dmlc_core_tpu.tracker.yarn_supervisor import (EXIT_KILLED_PMEM,

@@ -10,6 +10,7 @@ SigV4 Authorization header.
 from __future__ import annotations
 
 import hashlib
+import socket
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -99,15 +100,13 @@ class MockS3:
                 connection: the client sees IncompleteRead/reset mid-GET.
                 (shutdown(), not close(): the rfile/wfile makefile wrappers
                 hold socket refs, so close() alone never sends the FIN.)"""
-                import socket as socket_mod
-
                 self.send_response(status)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body[:max(1, len(body) // 2)])
                 self.wfile.flush()
                 self.close_connection = True
-                self.connection.shutdown(socket_mod.SHUT_RDWR)
+                self.connection.shutdown(socket.SHUT_RDWR)
 
             def do_GET(self):
                 if not self._check_auth():
@@ -215,10 +214,8 @@ class MockS3:
                         store.fail_complete_once = False
                     if drop:
                         # committed, but the client never hears back
-                        import socket as socket_mod
-
                         self.close_connection = True
-                        self.connection.shutdown(socket_mod.SHUT_RDWR)
+                        self.connection.shutdown(socket.SHUT_RDWR)
                         return
                     return self._reply(
                         200, b"<CompleteMultipartUploadResult/>")

@@ -17,9 +17,7 @@ coordinator rendezvous — is executed, not just string-asserted.
 
 import os
 import stat
-import subprocess
 import sys
-import threading
 
 import pytest
 

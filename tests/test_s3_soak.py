@@ -135,7 +135,6 @@ def test_complete_multipart_retry_after_commit_is_success(flaky_s3):
 def test_complete_multipart_lost_upload_fails_loudly(flaky_s3, monkeypatch):
     """404 on complete with no (or wrong-size) object at the key is a real
     loss and must raise, even when a stale object sits under the key."""
-    from dmlc_core_tpu.io.s3_filesys import S3FileSystem
     from dmlc_core_tpu.io import filesys as fsys
 
     flaky_s3.fail_every = 0
