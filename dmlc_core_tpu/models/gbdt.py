@@ -48,6 +48,15 @@ class GBDTParam(Parameter):
     reg_lambda = field(float, default=1.0, lower=0.0, help="L2 on leaf weights")
     min_child_weight = field(float, default=1.0, lower=0.0,
                              help="minimum hessian sum per child")
+    min_split_loss = field(float, default=0.0, lower=0.0,
+                           help="gamma: minimum gain to split a node")
+    # XGBoost's range is (0, 1]; the inclusive field bound keeps 0 out via
+    # the epsilon (subsample=0 would silently train all-empty trees)
+    subsample = field(float, default=1.0, lower=1e-6, upper=1.0,
+                      help="per-tree row subsampling rate")
+    colsample_bytree = field(float, default=1.0, lower=1e-6, upper=1.0,
+                             help="per-tree feature subsampling rate")
+    seed = field(int, default=0, help="subsampling PRNG seed")
     objective = field(str, default="logistic", enum=["logistic", "squared"],
                       help="loss")
     hist_method = field(str, default="auto",
@@ -82,9 +91,13 @@ def _grad_hess(margin, label, objective: str):
 def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 min_child_weight: float, learning_rate: float,
                 model_axis: Optional[str] = None, method: str = "scatter",
-                onehot=None):
+                onehot=None, min_split_loss: float = 0.0, feat_mask=None):
     """Grow one tree level-by-level; returns (split_feat, split_bin, leaf_value,
-    margin_delta).  Pure jax, shapes static in (max_depth, num_bins, F)."""
+    margin_delta).  Pure jax, shapes static in (max_depth, num_bins, F).
+
+    ``feat_mask`` ([F] bool, optional) disables features for this tree
+    (colsample); ``min_split_loss`` is the XGBoost gamma pruning threshold.
+    """
     import jax.numpy as jnp
 
     B, F = bins.shape
@@ -112,13 +125,15 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         valid = (HL >= min_child_weight) & (HR >= min_child_weight)
         # splitting on the last bin sends everything left: never valid
         valid = valid & (jnp.arange(num_bins) < num_bins - 1)[None, None, :]
+        if feat_mask is not None:
+            valid = valid & feat_mask[None, :, None]
         gain = jnp.where(valid, gain, -jnp.inf)
         flat = gain.reshape(n_nodes, F * num_bins)
         best = jnp.argmax(flat, axis=-1)                 # [n]
         best_gain = jnp.take_along_axis(flat, best[:, None], axis=-1)[:, 0]
         bf = (best // num_bins).astype(jnp.int32)
         bb = (best % num_bins).astype(jnp.int32)
-        do_split = best_gain > 0.0
+        do_split = best_gain > min_split_loss
         sf = jnp.where(do_split, bf, -1)
         split_feat = split_feat.at[level_off + jnp.arange(n_nodes)].set(sf)
         split_bin = split_bin.at[level_off + jnp.arange(n_nodes)].set(bb)
@@ -151,6 +166,30 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     leaf_value = (-Gl / (Hl + reg_lambda)) * learning_rate
     margin_delta = leaf_value[node]
     return split_feat, split_bin, leaf_value, margin_delta
+
+
+def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int):
+    """Per-tree (row_weight, feature_mask) for subsample/colsample; both
+    None at the default rates so the bench path traces unchanged.  ``rnd``
+    is the (traced) round index; sampling is deterministic in (seed, rnd).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    row_w = None
+    fmask = None
+    if p.subsample < 1.0 or p.colsample_bytree < 1.0:
+        key = jax.random.fold_in(jax.random.PRNGKey(p.seed),
+                                 jnp.asarray(rnd, jnp.uint32))
+        if p.subsample < 1.0:
+            row_w = (jax.random.uniform(jax.random.fold_in(key, 0), (B,))
+                     < p.subsample).astype(jnp.float32)
+        if p.colsample_bytree < 1.0:
+            u = jax.random.uniform(jax.random.fold_in(key, 1), (F,))
+            fmask = u < p.colsample_bytree
+            # never mask every feature: the cheapest column always stays
+            fmask = fmask.at[jnp.argmin(u)].set(True)
+    return row_w, fmask
 
 
 def _predict_tree(split_feat, split_bin, leaf_value, bins, max_depth: int):
@@ -226,8 +265,12 @@ class GBDT:
 
         p = self.param
 
-        def one_round(margin, bins, label, weight):
+        def one_round(margin, bins, label, weight, rnd):
             g, h = _grad_hess(margin, label, p.objective)
+            row_w, fmask = _tree_sampling(p, rnd, bins.shape[0],
+                                          bins.shape[1])
+            if row_w is not None:
+                weight = weight * row_w
             g = g * weight
             h = h * weight
             onehot = (bin_onehot(bins, p.num_bins)
@@ -235,7 +278,8 @@ class GBDT:
             sf, sb, lv, delta = _build_tree(
                 bins, g, h, p.max_depth, p.num_bins, p.reg_lambda,
                 p.min_child_weight, p.learning_rate, self.model_axis,
-                method=method, onehot=onehot)
+                method=method, onehot=onehot,
+                min_split_loss=p.min_split_loss, feat_mask=fmask)
             return margin + delta, (sf, sb, lv)
 
         return jax.jit(one_round)
@@ -268,19 +312,22 @@ class GBDT:
             onehot = (bin_onehot(bins, p.num_bins)
                       if method == "onehot" else None)
 
-            def body(margin, _):
+            def body(margin, rnd):
                 g, h = _grad_hess(margin, label, p.objective)
-                g = g * weight
-                h = h * weight
+                row_w, fmask = _tree_sampling(p, rnd, B, bins.shape[1])
+                w = weight if row_w is None else weight * row_w
+                g = g * w
+                h = h * w
                 sf, sb, lv, delta = _build_tree(
                     bins, g, h, p.max_depth, p.num_bins, p.reg_lambda,
                     p.min_child_weight, p.learning_rate, self.model_axis,
-                    method=method, onehot=onehot)
+                    method=method, onehot=onehot,
+                    min_split_loss=p.min_split_loss, feat_mask=fmask)
                 return margin + delta, (sf, sb, lv)
 
             margin0 = jnp.zeros((B,), dtype=jnp.float32)
-            margin, (sfs, sbs, lvs) = lax.scan(body, margin0, None,
-                                               length=num_rounds)
+            margin, (sfs, sbs, lvs) = lax.scan(
+                body, margin0, jnp.arange(num_rounds, dtype=jnp.uint32))
             return TreeEnsemble(sfs, sbs, lvs), margin[:n_rows]
 
         return jax.jit(fit)
@@ -322,11 +369,28 @@ class GBDT:
                             self._method(bins, batch=padded))(
             bins, jnp.asarray(label, jnp.float32), weight)
 
-    def boost_round(self, margin, bins, label, weight):
-        """One boosting round (the unit train step for streaming/bench)."""
+    def boost_round(self, margin, bins, label, weight,
+                    round_index: Optional[int] = None):
+        """One boosting round (the unit train step for streaming/bench).
+
+        ``round_index`` seeds the per-tree subsample/colsample draw (traced
+        scalar: varying it does not recompile).  It is REQUIRED when
+        sampling is enabled — otherwise every streamed round would silently
+        draw the identical row/feature subset.
+        """
+        import jax.numpy as jnp
+
+        if round_index is None:
+            CHECK(self.param.subsample >= 1.0
+                  and self.param.colsample_bytree >= 1.0,
+                  "boost_round needs round_index= when subsample/"
+                  "colsample_bytree are enabled (each tree must draw a "
+                  "fresh subset)")
+            round_index = 0
         return self._round_fn(self._method(bins, margin,
                                            batch=bins.shape[0]))(
-            margin, bins, label, weight)
+            margin, bins, label, weight,
+            jnp.asarray(round_index, jnp.uint32))
 
     def predict_margin(self, ensemble: TreeEnsemble, bins):
         return self._predict_fn()(ensemble, bins)
@@ -377,7 +441,8 @@ class GBDT:
         best_round, best_loss = -1, float("inf")
         tree_margin = self._tree_margin_fn()
         for r in range(self.param.num_boost_round):
-            margin, (sf, sb, lv) = self.boost_round(margin, bins, label, weight)
+            margin, (sf, sb, lv) = self.boost_round(margin, bins, label,
+                                                    weight, round_index=r)
             trees.append((sf, sb, lv))
             entry = {"round": r,
                      "train_loss": float(_logloss(margin, label,

@@ -79,3 +79,96 @@ def test_save_load_model(model_and_data, tmp_path):
     p1 = np.asarray(model.predict_margin(ensemble, bins_v))
     p2 = np.asarray(fresh.predict_margin(loaded, bins_v))
     np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_min_split_loss_prunes():
+    """A gamma above every achievable gain yields stump-free (leaf-only)
+    trees; gamma=0 reproduces the unregularized model exactly."""
+    x, y = make_data(2000, 7)
+    base = GBDTParam(num_boost_round=3, max_depth=3, num_bins=32)
+    m0 = GBDT(base, num_feature=4)
+    m0.make_bins(x)
+    bins = np.asarray(m0.bin_features(x))
+    ens0, _ = m0.fit_binned(bins, y)
+
+    pruned = GBDT(GBDTParam(num_boost_round=3, max_depth=3, num_bins=32,
+                            min_split_loss=1e9), num_feature=4)
+    pruned.boundaries = m0.boundaries
+    ensp, _ = pruned.fit_binned(bins, y)
+    assert np.all(np.asarray(ensp.split_feat) == -1), "gamma=1e9 must prune"
+    assert np.any(np.asarray(ens0.split_feat) >= 0)
+
+
+def test_subsample_colsample_deterministic_and_trains():
+    x, y = make_data(3000, 8)
+    param = GBDTParam(num_boost_round=10, max_depth=3, num_bins=32,
+                      subsample=0.7, colsample_bytree=0.5, seed=11)
+    m = GBDT(param, num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x))
+    ens1, margin1 = m.fit_binned(bins, y)
+    ens2, margin2 = m.fit_binned(bins, y)
+    # deterministic in (seed, round)
+    np.testing.assert_array_equal(np.asarray(ens1.split_feat),
+                                  np.asarray(ens2.split_feat))
+    acc = float(((np.asarray(margin1) > 0) == y).mean())
+    assert acc > 0.7, acc
+    # a different seed draws different trees
+    m3 = GBDT(GBDTParam(num_boost_round=10, max_depth=3, num_bins=32,
+                        subsample=0.7, colsample_bytree=0.5, seed=12),
+              num_feature=4)
+    m3.boundaries = m.boundaries
+    ens3, _ = m3.fit_binned(bins, y)
+    assert not np.array_equal(np.asarray(ens1.split_feat),
+                              np.asarray(ens3.split_feat))
+
+
+def test_default_rates_keep_exact_legacy_behavior():
+    """subsample=colsample=1, gamma=0 must trace the identical program (no
+    sampling ops) and give the same trees as before the feature existed."""
+    x, y = make_data(1500, 3)
+    m = GBDT(GBDTParam(num_boost_round=4, max_depth=3, num_bins=16),
+             num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x))
+    ens_fit, _ = m.fit_binned(bins, y)
+    # round-by-round path agrees with the scan path at defaults
+    import jax.numpy as jnp
+
+    margin = jnp.zeros(len(y), jnp.float32)
+    w = jnp.ones(len(y), jnp.float32)
+    trees = []
+    for r in range(4):
+        margin, (sf, sb, lv) = m.boost_round(margin, jnp.asarray(bins),
+                                             jnp.asarray(y, jnp.float32), w,
+                                             round_index=r)
+        trees.append(np.asarray(sf))
+    np.testing.assert_array_equal(np.stack(trees),
+                                  np.asarray(ens_fit.split_feat))
+
+
+def test_boost_round_requires_round_index_under_sampling():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    x, y = make_data(500, 9)
+    m = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=16,
+                       subsample=0.5), num_feature=4)
+    m.make_bins(x)
+    bins = jnp.asarray(m.bin_features(x))
+    margin = jnp.zeros(len(y), jnp.float32)
+    w = jnp.ones(len(y), jnp.float32)
+    with _pytest.raises(Exception, match="round_index"):
+        m.boost_round(margin, bins, jnp.asarray(y, jnp.float32), w)
+    # explicit index works
+    m.boost_round(margin, bins, jnp.asarray(y, jnp.float32), w,
+                  round_index=0)
+
+
+def test_zero_sampling_rates_rejected():
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        GBDTParam(subsample=0.0)
+    with _pytest.raises(Exception):
+        GBDTParam(colsample_bytree=0.0)
