@@ -30,6 +30,11 @@ def main():
                              "scatter"],
                     help="histogram algorithm (auto: pallas VMEM kernel on "
                          "TPU, scatter on CPU)")
+    ap.add_argument("--min-split-loss", type=float, default=0.0,
+                    help="gamma: minimum gain to split")
+    ap.add_argument("--subsample", type=float, default=1.0)
+    ap.add_argument("--colsample-bytree", type=float, default=1.0)
+    ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--checkpoint", default="")
     args = ap.parse_args()
 
@@ -63,7 +68,10 @@ def main():
 
     param = GBDTParam(num_boost_round=args.rounds, max_depth=args.max_depth,
                       num_bins=args.num_bins, learning_rate=args.learning_rate,
-                      hist_method=args.hist_method)
+                      hist_method=args.hist_method,
+                      min_split_loss=args.min_split_loss,
+                      subsample=args.subsample,
+                      colsample_bytree=args.colsample_bytree, seed=args.seed)
     model = GBDT(param, num_feature=args.num_feature)
     model.make_bins(x[: min(len(x), 100_000)])
     bins = np.asarray(model.bin_features(x)).astype(np.int32)
