@@ -57,8 +57,10 @@ class GBDTParam(Parameter):
     colsample_bytree = field(float, default=1.0, lower=1e-6, upper=1.0,
                              help="per-tree feature subsampling rate")
     seed = field(int, default=0, help="subsampling PRNG seed")
-    objective = field(str, default="logistic", enum=["logistic", "squared"],
-                      help="loss")
+    objective = field(str, default="logistic",
+                      enum=["logistic", "squared", "softmax"], help="loss")
+    num_class = field(int, default=1, lower=1,
+                      help="classes for objective=softmax (K trees/round)")
     hist_method = field(str, default="auto",
                         enum=["auto", "pallas", "pallas_fused", "onehot", "scatter"],
                         help="histogram algorithm: VMEM-resident pallas "
@@ -68,11 +70,16 @@ class GBDTParam(Parameter):
 
 
 class TreeEnsemble(NamedTuple):
-    """Stacked level-order trees: arrays lead with the tree axis [T, ...]."""
+    """Stacked level-order trees: arrays lead with the tree axis [T, ...].
 
-    split_feat: Any   # [T, 2**d - 1] int32, -1 = no split
-    split_bin: Any    # [T, 2**d - 1] int32
-    leaf_value: Any   # [T, 2**d] float32 (shrinkage already applied)
+    Multiclass (objective=softmax) ensembles carry a class axis after the
+    tree axis — [T, K, ...] — one tree per class per round (the XGBoost
+    multi:softmax layout).
+    """
+
+    split_feat: Any   # [T(, K), 2**d - 1] int32, -1 = no split
+    split_bin: Any    # [T(, K), 2**d - 1] int32
+    leaf_value: Any   # [T(, K), 2**d] float32 (shrinkage already applied)
 
     @property
     def num_trees(self) -> int:
@@ -86,6 +93,24 @@ def _grad_hess(margin, label, objective: str):
         p = 1.0 / (1.0 + jnp.exp(-margin))
         return p - label, p * (1.0 - p)
     return margin - label, jnp.ones_like(margin)
+
+
+def _softmax_grad_hess(margin, label, num_class: int):
+    """Per-class gradients for softmax cross-entropy: margin [B, K],
+    integer labels [B] -> (g, h) each [B, K].
+
+    Matches XGBoost's SoftmaxMultiClassObj exactly: h = max(2*p*(1-p), eps)
+    — the factor 2 keeps leaf values on the same scale as the XGBoost
+    baseline, and the clamp keeps -G/(H+lambda) finite at reg_lambda=0 for
+    confidently-classified leaves.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pr = jax.nn.softmax(margin, axis=1)
+    onehot = (label.astype(jnp.int32)[:, None]
+              == jnp.arange(num_class, dtype=jnp.int32)).astype(jnp.float32)
+    return pr - onehot, jnp.maximum(2.0 * pr * (1.0 - pr), 1e-16)
 
 
 def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
@@ -168,10 +193,12 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     return split_feat, split_bin, leaf_value, margin_delta
 
 
-def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int):
+def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int, class_index: int = 0):
     """Per-tree (row_weight, feature_mask) for subsample/colsample; both
     None at the default rates so the bench path traces unchanged.  ``rnd``
-    is the (traced) round index; sampling is deterministic in (seed, rnd).
+    is the (traced) round index; sampling is deterministic in
+    (seed, rnd, class_index) — each of a softmax round's K trees draws its
+    own subset, as XGBoost samples per tree, not per round.
     """
     import jax
     import jax.numpy as jnp
@@ -181,6 +208,8 @@ def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int):
     if p.subsample < 1.0 or p.colsample_bytree < 1.0:
         key = jax.random.fold_in(jax.random.PRNGKey(p.seed),
                                  jnp.asarray(rnd, jnp.uint32))
+        if class_index:
+            key = jax.random.fold_in(key, class_index)
         if p.subsample < 1.0:
             row_w = (jax.random.uniform(jax.random.fold_in(key, 0), (B,))
                      < p.subsample).astype(jnp.float32)
@@ -216,6 +245,8 @@ class GBDT:
 
     def __init__(self, param: GBDTParam, num_feature: int,
                  model_axis: Optional[str] = None):
+        CHECK(param.objective != "softmax" or param.num_class >= 2,
+              "objective=softmax needs num_class >= 2")
         self.param = param
         self.num_feature = num_feature
         self.model_axis = model_axis
@@ -312,20 +343,40 @@ class GBDT:
             onehot = (bin_onehot(bins, p.num_bins)
                       if method == "onehot" else None)
 
-            def body(margin, rnd):
-                g, h = _grad_hess(margin, label, p.objective)
-                row_w, fmask = _tree_sampling(p, rnd, B, bins.shape[1])
-                w = weight if row_w is None else weight * row_w
-                g = g * w
-                h = h * w
-                sf, sb, lv, delta = _build_tree(
-                    bins, g, h, p.max_depth, p.num_bins, p.reg_lambda,
+            K = p.num_class if p.objective == "softmax" else 1
+
+            def grow(bins_, g, h, rnd, fmask):
+                return _build_tree(
+                    bins_, g, h, p.max_depth, p.num_bins, p.reg_lambda,
                     p.min_child_weight, p.learning_rate, self.model_axis,
                     method=method, onehot=onehot,
                     min_split_loss=p.min_split_loss, feat_mask=fmask)
-                return margin + delta, (sf, sb, lv)
 
-            margin0 = jnp.zeros((B,), dtype=jnp.float32)
+            def body(margin, rnd):
+                if K == 1:
+                    row_w, fmask = _tree_sampling(p, rnd, B, bins.shape[1])
+                    w = weight if row_w is None else weight * row_w
+                    g, h = _grad_hess(margin, label, p.objective)
+                    sf, sb, lv, delta = grow(bins, g * w, h * w, rnd, fmask)
+                    return margin + delta, (sf, sb, lv)
+                # one tree per class, all from the same margin snapshot
+                # (XGBoost multi:softmax: gradients evaluated before any of
+                # the round's K updates land) — but each tree draws its own
+                # row/feature subset
+                g_all, h_all = _softmax_grad_hess(margin, label, K)
+                trees = []
+                for k in range(K):
+                    row_w, fmask = _tree_sampling(p, rnd, B, bins.shape[1],
+                                                  class_index=k)
+                    w = weight if row_w is None else weight * row_w
+                    trees.append(grow(bins, g_all[:, k] * w, h_all[:, k] * w,
+                                      rnd, fmask))
+                delta = jnp.stack([t[3] for t in trees], axis=1)  # [B, K]
+                return margin + delta, tuple(
+                    jnp.stack([t[i] for t in trees]) for i in range(3))
+
+            margin0 = jnp.zeros((B,) if K == 1 else (B, K),
+                                dtype=jnp.float32)
             margin, (sfs, sbs, lvs) = lax.scan(
                 body, margin0, jnp.arange(num_rounds, dtype=jnp.uint32))
             return TreeEnsemble(sfs, sbs, lvs), margin[:n_rows]
@@ -341,12 +392,22 @@ class GBDT:
         d = self.param.max_depth
 
         def predict(ensemble: TreeEnsemble, bins):
+            B = bins.shape[0]
+            multiclass = ensemble.split_feat.ndim == 3
+
             def body(acc, tree):
                 sf, sb, lv = tree
-                return acc + _predict_tree(sf, sb, lv, bins, d), None
+                if multiclass:
+                    delta = jnp.stack(
+                        [_predict_tree(sf[k], sb[k], lv[k], bins, d)
+                         for k in range(sf.shape[0])], axis=1)
+                else:
+                    delta = _predict_tree(sf, sb, lv, bins, d)
+                return acc + delta, None
 
-            B = bins.shape[0]
-            out, _ = lax.scan(body, jnp.zeros((B,), jnp.float32),
+            shape = ((B, ensemble.split_feat.shape[1]) if multiclass
+                     else (B,))
+            out, _ = lax.scan(body, jnp.zeros(shape, jnp.float32),
                               (ensemble.split_feat, ensemble.split_bin,
                                ensemble.leaf_value))
             return out
@@ -358,6 +419,13 @@ class GBDT:
         """Train on pre-binned features; returns (ensemble, final margin)."""
         import jax.numpy as jnp
 
+        if self.param.objective == "softmax":
+            host_labels = np.asarray(label)
+            CHECK(host_labels.size == 0
+                  or (host_labels.min() >= 0
+                      and host_labels.max() < self.param.num_class),
+                  f"softmax labels must lie in [0, {self.param.num_class}); "
+                  f"got range [{host_labels.min()}, {host_labels.max()}]")
         weight = (jnp.ones(bins.shape[0], jnp.float32)
                   if weight is None else jnp.asarray(weight))
         bins = jnp.asarray(bins)
@@ -380,6 +448,8 @@ class GBDT:
         """
         import jax.numpy as jnp
 
+        CHECK(self.param.objective != "softmax",
+              "softmax trains K trees per round: use fit_binned")
         if round_index is None:
             CHECK(self.param.subsample >= 1.0
                   and self.param.colsample_bytree >= 1.0,
@@ -396,11 +466,14 @@ class GBDT:
         return self._predict_fn()(ensemble, bins)
 
     def predict(self, ensemble: TreeEnsemble, bins):
+        import jax
         import jax.numpy as jnp
 
         margin = self.predict_margin(ensemble, bins)
         if self.param.objective == "logistic":
             return 1.0 / (1.0 + jnp.exp(-margin))
+        if self.param.objective == "softmax":
+            return jax.nn.softmax(margin, axis=1)     # [B, K] probabilities
         return margin
 
     # -- training with eval / early stopping ----------------------------------
@@ -426,6 +499,9 @@ class GBDT:
         """
         import jax.numpy as jnp
 
+        CHECK(self.param.objective != "softmax",
+              "fit_with_eval tracks binary/regression losses; train "
+              "softmax models with fit_binned")
         weight = (jnp.ones(bins.shape[0], jnp.float32)
                   if weight is None else jnp.asarray(weight))
         bins = jnp.asarray(bins)

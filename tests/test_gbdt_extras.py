@@ -172,3 +172,76 @@ def test_zero_sampling_rates_rejected():
         GBDTParam(subsample=0.0)
     with _pytest.raises(Exception):
         GBDTParam(colsample_bytree=0.0)
+
+
+def test_multiclass_softmax_trains_and_predicts():
+    """3-class blobs: K trees per round, [T, K, ...] ensemble, softmax
+    probabilities, accuracy well above chance."""
+    rng = np.random.RandomState(0)
+    K, per = 3, 700
+    centers = np.array([[2.0, 0, 0, 0], [0, 2.0, 0, 0], [0, 0, 2.0, 0]],
+                       dtype=np.float32)
+    x = np.concatenate([rng.randn(per, 4).astype(np.float32) * 0.7 + c
+                        for c in centers])
+    y = np.repeat(np.arange(K), per).astype(np.float32)
+    param = GBDTParam(num_boost_round=12, max_depth=3, num_bins=32,
+                      objective="softmax", num_class=K)
+    m = GBDT(param, num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x))
+    ens, margin = m.fit_binned(bins, y)
+    assert np.asarray(ens.split_feat).shape[:2] == (12, K)
+    assert margin.shape == (len(y), K)
+    acc = float((np.asarray(margin).argmax(1) == y).mean())
+    assert acc > 0.9, acc
+    # predict path reproduces the training margins and yields probabilities
+    pm = np.asarray(m.predict_margin(ens, bins))
+    np.testing.assert_allclose(pm, np.asarray(margin), rtol=1e-4, atol=1e-4)
+    probs = np.asarray(m.predict(ens, bins))
+    np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-5)
+
+
+def test_multiclass_save_load_roundtrip(tmp_path):
+    rng = np.random.RandomState(1)
+    x = rng.randn(600, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32) + (x[:, 1] > 0)
+    m = GBDT(GBDTParam(num_boost_round=4, max_depth=3, num_bins=16,
+                       objective="softmax", num_class=3), num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x))
+    ens, _ = m.fit_binned(bins, y)
+    uri = str(tmp_path / "mc.bin")
+    m.save_model(uri, ens)
+    fresh = GBDT(m.param, num_feature=4)
+    loaded = fresh.load_model(uri)
+    np.testing.assert_array_equal(np.asarray(loaded.split_feat),
+                                  np.asarray(ens.split_feat))
+    np.testing.assert_allclose(np.asarray(fresh.predict(loaded, bins)),
+                               np.asarray(m.predict(ens, bins)), rtol=1e-5)
+
+
+def test_softmax_guards():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="num_class"):
+        GBDT(GBDTParam(objective="softmax"), num_feature=4)
+    m = GBDT(GBDTParam(objective="softmax", num_class=3), num_feature=4)
+    with _pytest.raises(Exception, match="fit_binned"):
+        m.boost_round(jnp.zeros((8, 3)), jnp.zeros((8, 4), jnp.int32),
+                      jnp.zeros(8), jnp.ones(8))
+
+
+def test_softmax_label_range_checked():
+    import pytest as _pytest
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(100, 4).astype(np.float32)
+    m = GBDT(GBDTParam(num_boost_round=1, objective="softmax", num_class=3),
+             num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x))
+    with _pytest.raises(Exception, match="labels must lie"):
+        m.fit_binned(bins, np.full(100, 3.0, np.float32))   # 1-indexed K
+    with _pytest.raises(Exception, match="labels must lie"):
+        m.fit_binned(bins, np.full(100, -1.0, np.float32))
