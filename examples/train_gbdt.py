@@ -30,6 +30,10 @@ def main():
                              "scatter"],
                     help="histogram algorithm (auto: pallas VMEM kernel on "
                          "TPU, scatter on CPU)")
+    ap.add_argument("--objective", default="logistic",
+                    choices=["logistic", "squared", "softmax"])
+    ap.add_argument("--num-class", type=int, default=1,
+                    help="classes for --objective softmax")
     ap.add_argument("--min-split-loss", type=float, default=0.0,
                     help="gamma: minimum gain to split")
     ap.add_argument("--subsample", type=float, default=1.0)
@@ -71,14 +75,18 @@ def main():
                       hist_method=args.hist_method,
                       min_split_loss=args.min_split_loss,
                       subsample=args.subsample,
-                      colsample_bytree=args.colsample_bytree, seed=args.seed)
+                      colsample_bytree=args.colsample_bytree, seed=args.seed,
+                      objective=args.objective, num_class=args.num_class)
     model = GBDT(param, num_feature=args.num_feature)
     model.make_bins(x[: min(len(x), 100_000)])
     bins = np.asarray(model.bin_features(x)).astype(np.int32)
 
     (ensemble, margin), secs = device_timer(
         lambda b, yy: model.fit_binned(b, yy), bins, y)
-    acc = float(((np.asarray(margin) > 0) == y).mean())
+    if args.objective == "softmax":
+        acc = float((np.asarray(margin).argmax(1) == y).mean())
+    else:
+        acc = float(((np.asarray(margin) > 0) == y).mean())
     rows_per_sec = len(y) * args.rounds / secs
     print(f"trained {args.rounds} rounds on {len(y)} rows in {secs:.2f}s "
           f"({rows_per_sec:,.0f} rows/sec/chip), train acc {acc:.4f}")
