@@ -338,3 +338,54 @@ def test_indexed_native_batch_size_change_resyncs(tmp_path):
                 break
             got.append(bytes(r))
     assert got == records
+
+
+@pytest.mark.parametrize("fmt", ["line", "recordio"])
+def test_tiny_buffer_forces_native_growth(tmp_path, fmt):
+    """A buffer smaller than one record drives the C++ ReadChunk grow-retry
+    loop (reference Chunk::Load semantics) — records must still come out
+    whole and in order."""
+    from dmlc_core_tpu import native_bridge
+
+    if fmt == "line":
+        recs = [b"x" * (50 + 37 * i) for i in range(40)]
+        blob = b"\n".join(recs) + b"\n"
+        extract = None
+    else:
+        from dmlc_core_tpu.io.input_split import _next_recordio_record
+        from dmlc_core_tpu.io.memory_io import MemoryStringStream
+        from dmlc_core_tpu.io.recordio import RecordIOWriter
+
+        stream = MemoryStringStream()
+        w = RecordIOWriter(stream)
+        recs = [b"y" * (48 + 36 * i) for i in range(40)]
+        for r in recs:
+            w.write_record(r)
+        blob = bytes(stream.data)
+        extract = _next_recordio_record
+    p = tmp_path / ("d.txt" if fmt == "line" else "d.rec")
+    p.write_bytes(blob)
+    native = native_bridge.NativeLineSplit([str(p)], [len(blob)], 0, 1,
+                                           buffer_size=64, format=fmt)
+    chunks = []
+    while True:
+        c = native.next_chunk()
+        if c is None:
+            break
+        chunks.append(c)
+    native.close()
+    assert b"".join(chunks) == blob
+    if fmt == "line":
+        got = [ln for ln in b"".join(chunks).split(b"\n") if ln]
+    else:
+        from dmlc_core_tpu.io.input_split import ChunkCursor
+
+        got = []
+        for c in chunks:
+            cur = ChunkCursor(c)
+            while True:
+                r = extract(cur)
+                if r is None:
+                    break
+                got.append(bytes(r))
+    assert got == recs
