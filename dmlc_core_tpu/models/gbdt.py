@@ -267,27 +267,39 @@ class GBDT:
     def _method(self, *arrays, batch: Optional[int] = None) -> str:
         method = resolve_hist_method(self.param.hist_method, *arrays)
         if method in ("pallas", "pallas_fused"):
-            from dmlc_core_tpu.ops.hist_pallas import (hist_fits_vmem,
+            from dmlc_core_tpu.ops.hist_pallas import (hist_node_block,
                                                        sharded_hist_plan)
 
-            # the kernel keeps the deepest level's [2n, F*nbins] f32
-            # accumulator resident in VMEM; decide up front so the onehot
-            # fallback still amortises its matmul RHS across rounds.
-            # ``batch`` is the row count grad_histogram will actually see
-            # (padded for fit, raw for boost_round) so this gate and the
-            # in-trace one in grad_histogram cannot disagree.
+            # the kernel keeps a [2n, F*nbins] f32 accumulator resident in
+            # VMEM; deeper levels sweep node blocks (plain kernel only), and
+            # the onehot fallback kicks in only when even an 8-node block
+            # overflows.  Decide up front so the fallback still amortises
+            # its matmul RHS across rounds.  ``batch`` is the row count
+            # grad_histogram will actually see (padded for fit, raw for
+            # boost_round) so this gate and the in-trace one in
+            # grad_histogram cannot disagree.
             deepest = 2 ** (self.param.max_depth - 1)
             if self.model_axis is not None:
                 # model-sharded hist keeps the kernel via shard_map when an
                 # ambient mesh is set and features split evenly; each shard
                 # then only holds an F/mp slice of the accumulator
-                if sharded_hist_plan(self.model_axis, self.num_feature,
-                                     deepest, self.param.num_bins,
-                                     batch=batch) is None:
+                mesh = sharded_hist_plan(self.model_axis, self.num_feature,
+                                         deepest, self.param.num_bins,
+                                         batch=batch)
+                if mesh is None:
                     method = "onehot"
-            elif not hist_fits_vmem(deepest, self.num_feature,
-                                    self.param.num_bins):
-                method = "onehot"
+                elif method == "pallas_fused":
+                    mp = mesh.shape[self.model_axis]
+                    if hist_node_block(deepest, self.num_feature // mp,
+                                       self.param.num_bins) < deepest:
+                        method = "pallas"
+            else:
+                block = hist_node_block(deepest, self.num_feature,
+                                        self.param.num_bins)
+                if block is None:
+                    method = "onehot"
+                elif block < deepest and method == "pallas_fused":
+                    method = "pallas"   # blocked sweeps have no fused variant
         return method
 
     @functools.lru_cache(maxsize=None)

@@ -35,7 +35,7 @@ __all__ = ["hist_matmul_pallas", "grad_hist_pallas",
            "grad_hist_pallas_fused", "grad_hist_pallas_sharded",
            "ambient_mesh", "sharded_hist_plan", "pallas_supported",
            "pallas_fused_supported", "pallas_i8_supported", "hist_fits_vmem",
-           "BLOCK_ROWS", "DATA_AXIS"]
+           "hist_node_block", "BLOCK_ROWS", "DATA_AXIS"]
 
 # interpreter mode: runs the kernels on CPU for tests/debugging (flipped by
 # tests, or set DMLC_TPU_PALLAS_INTERPRET=1 to debug without a chip)
@@ -72,6 +72,27 @@ def hist_fits_vmem(num_nodes: int, num_feature: int, num_bins: int) -> bool:
     """Whether the resident [2*n_pad, F*nbins] f32 accumulator fits VMEM."""
     return 2 * _pad_nodes(num_nodes) * num_feature * num_bins * 4 \
         <= _ACC_BYTES_LIMIT
+
+
+def hist_node_block(num_nodes: int, num_feature: int, num_bins: int):
+    """Nodes per kernel sweep, or None when even 8 node slots overflow VMEM.
+
+    Deep tree levels whose full [2n, F*nbins] accumulator exceeds VMEM run
+    the kernel in node blocks: each sweep re-reads the bins tile and
+    re-builds the one-hot, but kernel cost is VPU-bound and m-independent
+    (measured — BASELINE.md r3 profile), so #sweeps scales the cost, while
+    the one-hot-matmul fallback's MXU work scales with the FULL node count
+    AND re-reads the 2n x B x F*nbins problem from HBM.  Blocking keeps the
+    kernel the fastest choice for every depth the GBDT allows.
+    """
+    if hist_fits_vmem(num_nodes, num_feature, num_bins):
+        return num_nodes
+    block = 1 << (num_nodes - 1).bit_length()
+    while block >= 8:
+        if hist_fits_vmem(block, num_feature, num_bins):
+            return block
+        block //= 2
+    return None
 
 
 def _accumulate_tile(w, bins_ref, out_ref, num_feature: int, num_bins: int):
@@ -161,7 +182,30 @@ def grad_hist_pallas(bins, node_ids, grad, hess, num_nodes: int,
     Same contract as :func:`.histogram.grad_histogram`; returns (G, H) each
     [num_nodes, F, num_bins] float32.  Rows with out-of-range (e.g. negative)
     node ids contribute nothing.
+
+    Levels too deep for one resident accumulator run in node blocks (see
+    :func:`hist_node_block`): shifting node ids by the block base makes the
+    kernel's own out-of-range drop do the partitioning.
     """
+    import jax.numpy as jnp
+
+    block = hist_node_block(num_nodes, bins.shape[1], num_bins)
+    assert block is not None, "caller must gate on hist_node_block"
+    if block < num_nodes:
+        node_ids = node_ids.astype(jnp.int32)
+        parts = [
+            _grad_hist_pallas_block(bins, node_ids - b0, grad, hess,
+                                    min(block, num_nodes - b0), num_bins)
+            for b0 in range(0, num_nodes, block)
+        ]
+        return (jnp.concatenate([p[0] for p in parts]),
+                jnp.concatenate([p[1] for p in parts]))
+    return _grad_hist_pallas_block(bins, node_ids, grad, hess, num_nodes,
+                                   num_bins)
+
+
+def _grad_hist_pallas_block(bins, node_ids, grad, hess, num_nodes: int,
+                            num_bins: int):
     import jax.numpy as jnp
 
     bins = jnp.asarray(bins).astype(jnp.int32)
@@ -272,7 +316,8 @@ def sharded_hist_plan(model_axis, num_feature: int, num_nodes: int,
     an ambient (or given) mesh carrying ``model_axis``, features dividing
     evenly across it, rows dividing across the data axis (``batch=None``
     skips that check for callers that pad rows later), and the per-shard
-    ``F/mp`` accumulator slice fitting VMEM.
+    ``F/mp`` slice supporting at least a node-blocked accumulator (deep
+    levels sweep node blocks inside each shard, same as unsharded).
     """
     if model_axis is None:
         return None
@@ -284,7 +329,8 @@ def sharded_hist_plan(model_axis, num_feature: int, num_nodes: int,
     dp = mesh.shape.get(DATA_AXIS, 1)
     if (mp is None or num_feature % mp != 0
             or (batch is not None and batch % dp != 0)
-            or not hist_fits_vmem(num_nodes, num_feature // mp, num_bins)):
+            or hist_node_block(num_nodes, num_feature // mp, num_bins)
+            is None):
         return None
     return mesh
 

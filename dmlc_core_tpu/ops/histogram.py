@@ -160,22 +160,32 @@ def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,
             method = "pallas" if pallas_supported() else "onehot"
     sharded_mesh = None
     if method in ("pallas", "pallas_fused"):
-        from dmlc_core_tpu.ops.hist_pallas import (hist_fits_vmem,
+        from dmlc_core_tpu.ops.hist_pallas import (hist_node_block,
                                                    sharded_hist_plan)
 
         if model_axis is None:
-            # the kernel keeps the whole [2n, F*nbins] accumulator resident
-            # in VMEM; beyond that the plain matmul (HBM-tiled) must take over
-            if not hist_fits_vmem(num_nodes, F, num_bins):
+            # the kernel keeps a [2n, F*nbins] accumulator resident in
+            # VMEM; deeper levels run in node blocks (plain kernel only —
+            # the blocked sweep has no fused variant), and only when even
+            # an 8-node block overflows does the matmul take over
+            block = hist_node_block(num_nodes, F, num_bins)
+            if block is None:
                 method = "onehot"
+            elif block < num_nodes and method == "pallas_fused":
+                method = "pallas"
         else:
             # model-sharded: pallas_call is not GSPMD-partitionable, but the
-            # kernel stays on via shard_map — each model shard runs it on its
-            # own F/mp feature slice (and only that slice must fit VMEM)
+            # kernel stays on via shard_map — each model shard runs it (node-
+            # blocked when deep) on its own F/mp feature slice
             sharded_mesh = sharded_hist_plan(model_axis, F, num_nodes,
                                              num_bins, batch=B)
             if sharded_mesh is None:
                 method = "onehot"
+            elif method == "pallas_fused":
+                mp = sharded_mesh.shape[model_axis]
+                if hist_node_block(num_nodes, F // mp,
+                                   num_bins) < num_nodes:
+                    method = "pallas"   # blocked sweeps have no fused variant
 
     if method in ("pallas", "pallas_fused") and sharded_mesh is not None:
         from dmlc_core_tpu.ops.hist_pallas import grad_hist_pallas_sharded

@@ -71,16 +71,27 @@ def test_grad_histogram_dispatches_pallas():
                                rtol=2e-2, atol=2e-2)
 
 
-def test_vmem_overflow_falls_back_to_onehot():
-    """auto on deep trees must not pick pallas (accumulator exceeds VMEM)."""
+def test_vmem_overflow_blocks_or_falls_back():
+    """Deep trees keep the kernel via node-blocked sweeps; onehot only when
+    even an 8-node block overflows VMEM."""
     from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
-    from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem
+    from dmlc_core_tpu.ops.hist_pallas import hist_fits_vmem, hist_node_block
 
     assert hist_fits_vmem(32, 28, 256)
     assert not hist_fits_vmem(512, 28, 256)       # depth-10 deepest level
-    model = GBDT(GBDTParam(max_depth=10, num_bins=256, hist_method="pallas"),
-                 num_feature=28)
-    assert model._method() == "onehot"
+    assert hist_node_block(512, 28, 256) == 128   # ... -> 4 blocked sweeps
+    assert hist_node_block(32, 28, 256) == 32     # fits: single sweep
+    assert hist_node_block(512, 512, 1024) is None  # 8-node block > VMEM
+    deep = GBDT(GBDTParam(max_depth=10, num_bins=256, hist_method="pallas"),
+                num_feature=28)
+    assert deep._method() == "pallas"             # blocked, not onehot
+    wide = GBDT(GBDTParam(max_depth=10, num_bins=1024,
+                          hist_method="pallas"), num_feature=512)
+    assert wide._method() == "onehot"
+    # a user-selected fused method degrades to the (blockable) plain kernel
+    deep_fused = GBDT(GBDTParam(max_depth=10, num_bins=256,
+                                hist_method="pallas_fused"), num_feature=28)
+    assert deep_fused._method() == "pallas"
     shallow = GBDT(GBDTParam(max_depth=6, num_bins=256,
                              hist_method="pallas"), num_feature=28)
     assert shallow._method() == "pallas"
@@ -88,6 +99,34 @@ def test_vmem_overflow_falls_back_to_onehot():
                              hist_method="pallas"), num_feature=28,
                    model_axis="model")
     assert sharded._method() == "onehot"
+
+
+def test_blocked_hist_matches_scatter():
+    """Node counts beyond one VMEM accumulator: the blocked sweep must give
+    the same histogram as the exact scatter."""
+    # shrink the budget so blocking triggers at test-size shapes (module
+    # attribute, NOT a from-import: the mutation must hit the live gate)
+    orig = hist_pallas._ACC_BYTES_LIMIT
+    hist_pallas._ACC_BYTES_LIMIT = 2 * 8 * 3 * 16 * 4   # 8-node blocks
+    try:
+        assert hist_pallas.hist_node_block(32, 3, 16) == 8
+        bins, node, g, h = _rand_case(700, 3, 16, 32, seed=31)
+        G, H = hist_pallas.grad_hist_pallas(bins, node, g, h, 32, 16)
+        Gr, Hr = grad_histogram(bins, node, g, h, 32, 16, method="scatter")
+        assert G.shape == (32, 3, 16)
+        np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                                   rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(np.asarray(H), np.asarray(Hr),
+                                   rtol=2e-2, atol=2e-2)
+        # non-power-of-two node count: last block is short
+        bins, node, g, h = _rand_case(500, 3, 16, 20, seed=32)
+        G, _ = hist_pallas.grad_hist_pallas(bins, node, g, h, 20, 16)
+        Gr, _ = grad_histogram(bins, node, g, h, 20, 16, method="scatter")
+        assert G.shape == (20, 3, 16)
+        np.testing.assert_allclose(np.asarray(G), np.asarray(Gr),
+                                   rtol=2e-2, atol=2e-2)
+    finally:
+        hist_pallas._ACC_BYTES_LIMIT = orig
 
 
 def test_non_power_of_two_nodes_padding():
