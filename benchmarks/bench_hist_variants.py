@@ -63,12 +63,6 @@ def main():
         model._fit_fn.cache_clear()
 
 
-if __name__ == "__main__":
-    os.environ.setdefault("BENCH", "1")
-    if "--deep" in sys.argv:
-        deep_tree_ab()
-    else:
-        main()
 
 
 def deep_tree_ab(rows=100_000):
@@ -103,3 +97,11 @@ def deep_tree_ab(rows=100_000):
             best = min(best, time.perf_counter() - t0)
         print(f"depth-10 {method:7s}: {best * 1e3:7.1f} ms  "
               f"{rows * R / best / 1e6:6.2f}M rows/s")
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("BENCH", "1")
+    if "--deep" in sys.argv:
+        deep_tree_ab()
+    else:
+        main()
