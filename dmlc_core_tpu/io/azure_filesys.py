@@ -27,6 +27,7 @@ import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
 
 from dmlc_core_tpu.io import filesys as fsys
+from dmlc_core_tpu.io.net_retry import request_with_retries
 from dmlc_core_tpu.io.stream import SeekStream, Stream
 from dmlc_core_tpu.param import get_env
 from dmlc_core_tpu.registry import Registry
@@ -74,33 +75,44 @@ class _AzureClient:
                 ok: Tuple[int, ...] = (200, 201),
                 ) -> Tuple[int, Dict[str, str], bytes]:
         query = {k: str(v) for k, v in (query or {}).items()}
-        headers = dict(headers or {})
-        now = datetime.datetime.now(datetime.timezone.utc)
-        headers["x-ms-date"] = now.strftime("%a, %d %b %Y %H:%M:%S GMT")
-        headers["x-ms-version"] = "2021-08-06"
-        clen = str(len(body)) if body else ""
-        headers["Authorization"] = self._sign(method, path, query, headers,
-                                              clen)
-        if body:
-            headers["Content-Length"] = clen
+        base_headers = dict(headers or {})
         url = f"/{self.container}"
         if path:
             url += "/" + urllib.parse.quote(path)
         if query:
             url += "?" + urllib.parse.urlencode(sorted(query.items()))
-        conn = (http.client.HTTPSConnection if self.secure
-                else http.client.HTTPConnection)(self.host, timeout=60)
-        try:
-            conn.request(method, url, body=body or None, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            rheaders = {k.lower(): v for k, v in resp.getheaders()}
-            if resp.status not in ok:
-                log_fatal(f"azure error {resp.status} on {method} {url}: "
-                          f"{data[:500]!r}")
-            return resp.status, rheaders, data
-        finally:
-            conn.close()
+
+        def perform():
+            # sign per attempt: x-ms-date must stay within Azure's clock-skew
+            # window even after long retry backoffs
+            hdrs = dict(base_headers)
+            now = datetime.datetime.now(datetime.timezone.utc)
+            hdrs["x-ms-date"] = now.strftime("%a, %d %b %Y %H:%M:%S GMT")
+            hdrs["x-ms-version"] = "2021-08-06"
+            clen = str(len(body)) if body else ""
+            hdrs["Authorization"] = self._sign(method, path, query, hdrs,
+                                               clen)
+            if body:
+                hdrs["Content-Length"] = clen
+            conn = (http.client.HTTPSConnection if self.secure
+                    else http.client.HTTPConnection)(self.host, timeout=60)
+            try:
+                conn.request(method, url, body=body or None, headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+                return (resp.status,
+                        {k.lower(): v for k, v in resp.getheaders()}, data)
+            finally:
+                conn.close()
+
+        # shared retry policy (net_retry); Put Block / Put Block List are
+        # idempotent per block id, so replays are safe
+        status, rheaders, data = request_with_retries(
+            perform, ok, f"{method} {self.host}{url}")
+        if status not in ok:
+            log_fatal(f"azure error {status} on {method} {url}: "
+                      f"{data[:500]!r}")
+        return status, rheaders, data
 
 
 class _AzureReadStream(SeekStream):

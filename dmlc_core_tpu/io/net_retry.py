@@ -1,0 +1,68 @@
+"""Connection-reestablishing retry shared by the remote-FS HTTP clients.
+
+The reference re-connects on curl errors and short reads
+(src/io/s3_filesys.cc:318-341, 703-733).  Every client here opens a fresh
+connection per request, so a retry IS a re-connect; this module is the one
+place the transport failure set, transient status set, and backoff policy
+live, so the S3/GCS and Azure clients cannot drift.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import socket
+import ssl
+import time
+from typing import Callable, Dict, Tuple
+
+from dmlc_core_tpu.param import get_env
+
+__all__ = ["RETRYABLE_EXC", "RETRYABLE_STATUS", "request_with_retries"]
+
+logger = logging.getLogger("dmlc_core_tpu.io.net")
+
+# transport-level failures worth re-establishing a connection for
+RETRYABLE_EXC = (ConnectionError, socket.timeout, ssl.SSLError,
+                 http.client.IncompleteRead, http.client.BadStatusLine,
+                 http.client.CannotSendRequest, http.client.ResponseNotReady)
+# server statuses that are transient by contract (503 SlowDown on S3,
+# 429 rateLimitExceeded on the GCS interop API / Azure throttling, 5xx)
+RETRYABLE_STATUS = (429, 500, 502, 503)
+
+Response = Tuple[int, Dict[str, str], bytes]
+
+
+def request_with_retries(perform: Callable[[], Response],
+                         ok: Tuple[int, ...],
+                         describe: str) -> Response:
+    """Run ``perform`` (one full connect+send+read) with retry.
+
+    Transport failures and transient statuses retry up to
+    ``S3_MAX_ERROR_RETRY`` times (default 3) with 100 ms doubling backoff;
+    ``perform`` is called fresh each attempt, so time-sensitive signatures
+    re-sign.  Statuses in ``ok`` are returned immediately; non-ok final
+    statuses are returned to the caller to report (not raised here).
+    """
+    max_retry = get_env("S3_MAX_ERROR_RETRY", int, 3)
+    delay = 0.1
+    for attempt in range(max_retry + 1):
+        try:
+            status, headers, data = perform()
+        except RETRYABLE_EXC as exc:
+            if attempt >= max_retry:
+                raise
+            logger.warning("re-establishing connection (%s, retry %d): %s",
+                           describe, attempt + 1, exc)
+            time.sleep(delay)
+            delay *= 2
+            continue
+        if status in RETRYABLE_STATUS and status not in ok \
+                and attempt < max_retry:
+            logger.warning("%s returned %d; retry %d", describe, status,
+                           attempt + 1)
+            time.sleep(delay)
+            delay *= 2
+            continue
+        return status, headers, data
+    raise AssertionError("unreachable")

@@ -24,17 +24,15 @@ from __future__ import annotations
 
 import hashlib
 import http.client
-import logging
 import os
-import socket
 import ssl
-import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
 
 from dmlc_core_tpu.io import filesys as fsys
 from dmlc_core_tpu.io.aws_sig import Credentials, sign_request
+from dmlc_core_tpu.io.net_retry import request_with_retries
 from dmlc_core_tpu.io.stream import SeekStream, Stream
 from dmlc_core_tpu.param import get_env
 from dmlc_core_tpu.registry import Registry
@@ -43,18 +41,6 @@ from dmlc_core_tpu.utils.logging import CHECK, log_fatal
 __all__ = ["S3FileSystem", "GCSFileSystem"]
 
 _EMPTY_SHA = hashlib.sha256(b"").hexdigest()
-
-logger = logging.getLogger("dmlc_core_tpu.io.s3")
-
-# transport-level failures worth re-establishing a connection for
-# (the reference's curl!=CURLE_OK + short-read re-connect loops,
-# s3_filesys.cc:318-341 and :703-733)
-_RETRYABLE_EXC = (ConnectionError, socket.timeout, ssl.SSLError,
-                  http.client.IncompleteRead, http.client.BadStatusLine,
-                  http.client.CannotSendRequest, http.client.ResponseNotReady)
-# server statuses that are transient by contract (503 SlowDown on S3,
-# 429 rateLimitExceeded on the GCS interop API)
-_RETRYABLE_STATUS = (429, 500, 502, 503)
 
 
 class _S3Client:
@@ -96,57 +82,42 @@ class _S3Client:
     def request(self, method: str, key: str, query: Optional[Dict] = None,
                 body: bytes = b"", headers: Optional[Dict] = None,
                 ok: Tuple[int, ...] = (200,)) -> Tuple[int, Dict[str, str], bytes]:
-        """One signed request with connection-reestablishing retry.
+        """One signed request with connection-reestablishing retry (see
+        :mod:`.net_retry` for the shared failure/backoff policy).
 
-        Transport failures (drops mid-transfer, resets, timeouts) and
-        transient 5xx statuses retry up to ``S3_MAX_ERROR_RETRY`` times with
-        100 ms doubling backoff — the reference re-connects the same way on
-        curl errors and short reads (s3_filesys.cc:318-341, 703-733; every
-        request here is a fresh connection, so a retry IS a re-connect).
         All client request types are safe to repeat: GETs/HEADs are
-        idempotent, part PUTs re-upload the same part, and S3 treats a
-        repeated complete-multipart POST for the same upload as idempotent.
+        idempotent, part PUTs re-upload the same part, and a retried
+        complete-multipart POST is reconciled by the 404 handling in
+        :meth:`S3WriteStream.close`.
         """
         query = {k: str(v) for k, v in (query or {}).items()}
         path = self.base_path + ("/" + key.lstrip("/") if key else "")
         payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA
-        signed = sign_request(self.creds, method, self.host, path, query,
-                              dict(headers or {}), payload_hash,
-                              service="s3")
         qs = urllib.parse.urlencode(sorted(query.items()))
         url = path + (f"?{qs}" if qs else "")
-        max_retry = get_env("S3_MAX_ERROR_RETRY", int, 3)
-        delay = 0.1
-        for attempt in range(max_retry + 1):
+
+        def perform():
+            # sign per attempt: long backoffs must not outlive the SigV4
+            # clock-skew window on a replayed x-amz-date
+            signed = sign_request(self.creds, method, self.host, path, query,
+                                  dict(headers or {}), payload_hash,
+                                  service="s3")
             conn = self._connect()
             try:
                 conn.request(method, url, body=body or None, headers=signed)
                 resp = conn.getresponse()
                 data = resp.read()
-                rheaders = {k.lower(): v for k, v in resp.getheaders()}
-            except _RETRYABLE_EXC as exc:
-                if attempt >= max_retry:
-                    raise
-                logger.warning("re-establishing connection to %s (%s %s, "
-                               "retry %d): %s", self.host, method, url,
-                               attempt + 1, exc)
-                time.sleep(delay)
-                delay *= 2
-                continue
+                return (resp.status,
+                        {k.lower(): v for k, v in resp.getheaders()}, data)
             finally:
                 conn.close()
-            if resp.status in _RETRYABLE_STATUS and resp.status not in ok \
-                    and attempt < max_retry:
-                logger.warning("%s %s returned %d; retry %d", method, url,
-                               resp.status, attempt + 1)
-                time.sleep(delay)
-                delay *= 2
-                continue
-            if resp.status not in ok:
-                log_fatal(f"{self.service} error {resp.status} on "
-                          f"{method} {url}: {data[:500]!r}")
-            return resp.status, rheaders, data
-        raise AssertionError("unreachable")
+
+        status, rheaders, data = request_with_retries(
+            perform, ok, f"{method} {self.host}{url}")
+        if status not in ok:
+            log_fatal(f"{self.service} error {status} on "
+                      f"{method} {url}: {data[:500]!r}")
+        return status, rheaders, data
 
 
 class S3ReadStream(SeekStream):

@@ -16,6 +16,7 @@ class MockAzure:
     def __init__(self):
         self.blobs = {}     # (container, name) -> bytes
         self.blocks = {}    # (container, name) -> {block_id: bytes}
+        self.drop_next_get = 0   # drop N data GETs mid-body (retry tests)
 
     def start(self):
         store = self
@@ -88,11 +89,25 @@ class MockAzure:
                 if data is None:
                     return self._reply(404)
                 rng = self.headers.get("Range")
+                piece, status = data, 200
                 if rng:
                     start_s, end_s = rng.split("=")[1].split("-")
                     start, end = int(start_s), min(int(end_s), len(data) - 1)
-                    return self._reply(206, data[start:end + 1])
-                self._reply(200, data)
+                    piece, status = data[start:end + 1], 206
+                if store.drop_next_get > 0:
+                    store.drop_next_get -= 1
+                    # half the body, then FIN: client sees IncompleteRead
+                    import socket as socket_mod
+
+                    self.send_response(status)
+                    self.send_header("Content-Length", str(len(piece)))
+                    self.end_headers()
+                    self.wfile.write(piece[:max(1, len(piece) // 2)])
+                    self.wfile.flush()
+                    self.close_connection = True
+                    self.connection.shutdown(socket_mod.SHUT_RDWR)
+                    return
+                self._reply(status, piece)
 
             def do_PUT(self):
                 if not self._auth_ok():
@@ -168,3 +183,15 @@ def test_listing(mock_azure):
     assert names["/d/sub"] == fsys.FileType.DIRECTORY
     info = fs.get_path_info(fsys.URI("azure://cont/d/b"))
     assert info.size == 2
+
+
+def test_read_survives_connection_drop(mock_azure):
+    """The shared net_retry policy applies to Azure reads: a mid-body drop
+    is retried transparently (reference reconnect semantics)."""
+    payload = bytes(range(256)) * 512
+    mock_azure.blobs[("cont", "blob.bin")] = payload
+    mock_azure.drop_next_get = 2
+    fo = create_stream_for_read("azure://cont/blob.bin")
+    got = fo.read(len(payload))
+    assert got == payload
+    assert mock_azure.drop_next_get == 0

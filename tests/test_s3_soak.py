@@ -36,6 +36,11 @@ def flaky_s3(monkeypatch):
     monkeypatch.setenv("S3_ENDPOINT", f"http://127.0.0.1:{server.port}")
     # small read buffer => many ranged GETs => many injected drops
     monkeypatch.setenv("DMLC_S3_WRITE_BUFFER_MB", "1")
+    # the every-Nth drop counter is shared across jobs, so one request's
+    # retries can keep landing on drop slots (p ~ (1/7)^k); a generous
+    # budget makes spurious exhaustion ~impossible without weakening the
+    # retry exercise (the dedicated exhaustion test pins its own budget)
+    monkeypatch.setenv("S3_MAX_ERROR_RETRY", "6")
     yield server
     server.stop()
 
