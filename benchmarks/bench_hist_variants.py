@@ -16,6 +16,27 @@ import time
 import numpy as np
 
 
+
+def fit_time(model, method, bins, y, rounds):
+    """Warm-compile then best-of-3 full-fit wall clock on the default device."""
+    import jax
+
+    dev = jax.devices()[0]
+    fit = model._fit_fn(rounds, method)
+    b = jax.device_put(bins, dev)
+    yy = jax.device_put(y, dev)
+    ww = jax.device_put(np.ones(len(y), np.float32), dev)
+    _, m = fit(b, yy, ww)
+    jax.block_until_ready(m)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _, m = fit(b, yy, ww)
+        jax.block_until_ready(m)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def main():
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
     import jax
@@ -34,28 +55,11 @@ def main():
     model = GBDT(param, num_feature=F)
     model.make_bins(x[:50_000])
     bins = np.asarray(apply_bins(x, model.boundaries)).astype(np.int32)
-    dev = jax.devices()[0]
-    ones = np.ones(rows, np.float32)
-    print(f"device: {dev}  rows={rows}  "
+    print(f"device: {jax.devices()[0]}  rows={rows}  "
           f"i8_supported={hist_pallas.pallas_i8_supported()}")
 
-    def fit_time(method):
-        fit = model._fit_fn(R, method)
-        b = jax.device_put(bins, dev)
-        yy = jax.device_put(y, dev)
-        ww = jax.device_put(ones, dev)
-        _, m = fit(b, yy, ww)
-        jax.block_until_ready(m)
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            _, m = fit(b, yy, ww)
-            jax.block_until_ready(m)
-            best = min(best, time.perf_counter() - t0)
-        return best
-
     for method in ("pallas", "pallas_fused", "onehot"):
-        dt = fit_time(method)
+        dt = fit_time(model, method, bins, y, R)
         print(f"{method:13s}: {dt * 1e3:7.1f} ms  "
               f"{rows * R / dt / 1e6:6.2f}M rows/s")
         # fresh compilation caches per method set are keyed by method only;
@@ -80,21 +84,8 @@ def deep_tree_ab(rows=100_000):
                  num_feature=F)
     model.make_bins(x[:50_000])
     bins = np.asarray(apply_bins(x, model.boundaries)).astype(np.int32)
-    dev = jax.devices()[0]
-    ones = np.ones(rows, np.float32)
     for method in ("pallas", "onehot"):
-        fit = model._fit_fn(R, method)
-        b = jax.device_put(bins, dev)
-        yy = jax.device_put(y, dev)
-        ww = jax.device_put(ones, dev)
-        _, m = fit(b, yy, ww)
-        jax.block_until_ready(m)
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            _, m = fit(b, yy, ww)
-            jax.block_until_ready(m)
-            best = min(best, time.perf_counter() - t0)
+        best = fit_time(model, method, bins, y, R)
         print(f"depth-10 {method:7s}: {best * 1e3:7.1f} ms  "
               f"{rows * R / best / 1e6:6.2f}M rows/s")
 

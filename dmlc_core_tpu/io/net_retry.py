@@ -27,8 +27,9 @@ RETRYABLE_EXC = (ConnectionError, socket.timeout, ssl.SSLError,
                  http.client.IncompleteRead, http.client.BadStatusLine,
                  http.client.CannotSendRequest, http.client.ResponseNotReady)
 # server statuses that are transient by contract (503 SlowDown on S3,
-# 429 rateLimitExceeded on the GCS interop API / Azure throttling, 5xx)
-RETRYABLE_STATUS = (429, 500, 502, 503)
+# 429 rateLimitExceeded on the GCS interop API / Azure throttling,
+# 5xx incl. 504 from front-end proxies)
+RETRYABLE_STATUS = (429, 500, 502, 503, 504)
 
 Response = Tuple[int, Dict[str, str], bytes]
 

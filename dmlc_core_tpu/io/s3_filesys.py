@@ -27,6 +27,7 @@ import http.client
 import os
 import ssl
 import urllib.parse
+import uuid
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
 
@@ -41,6 +42,10 @@ from dmlc_core_tpu.utils.logging import CHECK, log_fatal
 __all__ = ["S3FileSystem", "GCSFileSystem"]
 
 _EMPTY_SHA = hashlib.sha256(b"").hexdigest()
+
+# metadata key marking which multipart upload produced an object (see
+# S3WriteStream._init_multipart)
+_TOKEN_HEADER = "x-amz-meta-dmlc-write-token"
 
 
 class _S3Client:
@@ -174,11 +179,18 @@ class S3WriteStream(Stream):
         self._upload_id: Optional[str] = None
         self._etags: List[str] = []
         self._total_bytes = 0
+        self._write_token = ""
         self._closed = False
 
     def _init_multipart(self) -> None:
-        _, _, data = self._client.request("POST", self._key,
-                                          query={"uploads": ""})
+        # unique write token carried as object metadata: the one
+        # store-agnostic way to later prove "the object at this key is THIS
+        # upload" (ETag arithmetic breaks on SSE-KMS/interop stores whose
+        # part ETags are not plain part-MD5s)
+        self._write_token = uuid.uuid4().hex
+        _, _, data = self._client.request(
+            "POST", self._key, query={"uploads": ""},
+            headers={_TOKEN_HEADER: self._write_token})
         root = ET.fromstring(data)
         node = root.find("{*}UploadId")
         if node is None:
@@ -222,39 +234,26 @@ class S3WriteStream(Stream):
         # CompleteMultipartUpload is the one non-idempotent call: if a
         # transport retry re-sends it after S3 already committed, S3 answers
         # 404 NoSuchUpload.  Accept the 404 only when the object at the key
-        # is provably THIS upload: the multipart ETag is derivable from the
-        # collected part ETags (md5 of concatenated part-md5s, "-N" suffix),
-        # which distinguishes our bytes from a stale same-size object under
-        # an overwritten key (the fixed-shape checkpoint case).  Size is the
-        # fallback when the store returns non-standard part ETags.
+        # is provably THIS upload: it must carry the unique write token we
+        # attached at initiate (object metadata survives the complete), and
+        # have exactly the bytes we wrote — a stale same-size object under
+        # an overwritten key (the fixed-shape checkpoint case) has neither.
         status, _, _ = self._client.request(
             "POST", self._key, query={"uploadId": self._upload_id},
             body=body, ok=(200, 404))
         if status == 404:
             hs, headers, _ = self._client.request("HEAD", self._key,
                                                   ok=(200, 404))
-            landed = (hs == 200 and
-                      int(headers.get("content-length", -1))
-                      == self._total_bytes)
-            expected = self._multipart_etag()
-            if landed and expected is not None:
-                landed = headers.get("etag", "").strip('"') == expected
+            landed = (hs == 200
+                      and int(headers.get("content-length", -1))
+                      == self._total_bytes
+                      and headers.get(_TOKEN_HEADER.lower(), "")
+                      == self._write_token)
             CHECK(landed,
                   f"multipart upload of {self._key} lost: complete returned "
                   f"NoSuchUpload and the object at the key is missing or is "
                   f"not this upload (expected {self._total_bytes} bytes, "
-                  f"etag {expected})")
-
-    def _multipart_etag(self) -> Optional[str]:
-        """The ETag S3 assigns a completed multipart upload, from the part
-        ETags we collected — or None when parts carried non-md5 tags."""
-        try:
-            digest = hashlib.md5(
-                b"".join(bytes.fromhex(e.strip('"')) for e in self._etags)
-            ).hexdigest()
-        except ValueError:
-            return None
-        return f"{digest}-{len(self._etags)}"
+                  f"write token {self._write_token})")
 
     def __del__(self):
         try:

@@ -140,7 +140,9 @@ class RestYarnCluster(ClusterBackend):
         """
         try:
             self._submit_app(task)
-        except OSError as exc:   # URLError/HTTPError are OSError subclasses
+        except (OSError, ValueError) as exc:
+            # URLError/HTTPError are OSError subclasses; ValueError covers a
+            # proxy/LB answering 200 with a non-JSON body mid-outage
             logger.warning("submit of task %d failed (%s); will retry",
                            task.task_id, exc)
             self.submit_backlog.append(task)
@@ -204,7 +206,7 @@ class RestYarnCluster(ClusterBackend):
         for app_id in list(self.live):
             try:
                 _, body = _rest(self.rm_uri, f"/ws/v1/cluster/apps/{app_id}")
-            except (urllib.error.URLError, OSError) as exc:
+            except (urllib.error.URLError, OSError, ValueError) as exc:
                 # transient RM errors must not crash a long-lived supervision
                 # loop; persistent ones mean the container is lost
                 n = self.poll_errors.get(app_id, 0) + 1

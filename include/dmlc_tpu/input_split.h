@@ -49,6 +49,16 @@ class InputSplit {
   InputSplit(const std::vector<FileSpec> &files, int64_t part, int64_t nparts,
              Format format = Format::kLine,
              int64_t buffer_size = 8 << 20) {
+    if (format == Format::kRecordIO) {
+      // same invariant the Python entry points enforce: unaligned sizes
+      // would word-scan off-phase and silently corrupt record framing
+      for (const auto &f : files) {
+        if (f.size % 4 != 0) {
+          throw std::runtime_error("RecordIO file " + f.path +
+                                   " does not align by 4 bytes");
+        }
+      }
+    }
     detail::EncodedFiles enc(files);
     auto open = format == Format::kRecordIO ? &dmlc_tpu_rsplit_open
                                             : &dmlc_tpu_lsplit_open;

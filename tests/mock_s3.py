@@ -16,10 +16,27 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+
+def drop_mid_body(handler, status, body):
+    """Advertise the full Content-Length, send half the bytes, then force a
+    FIN: the client observes IncompleteRead/reset mid-transfer.  shutdown(),
+    not close() — the rfile/wfile makefile wrappers hold socket refs, so
+    close() alone never sends the FIN.  Shared by the S3 and Azure mocks so
+    the subtlety lives in one place."""
+    handler.send_response(status)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body[:max(1, len(body) // 2)])
+    handler.wfile.flush()
+    handler.close_connection = True
+    handler.connection.shutdown(socket.SHUT_RDWR)
+
+
 class MockS3:
     def __init__(self, fail_every: int = 0):
         self.objects = {}      # (bucket, key) -> bytes
         self.etags = {}        # (bucket, key) -> etag (no quotes)
+        self.meta = {}         # (bucket, key) -> {meta header: value}
         self.uploads = {}      # upload_id -> {"key":..., "parts": {n: bytes}}
         self.next_upload = [0]
         self.lock = threading.Lock()
@@ -81,8 +98,10 @@ class MockS3:
                 else:
                     etag = store.etags.get(
                         (bucket, key), hashlib.md5(data).hexdigest())
-                    self._reply(200, b"", {"Content-Length": str(len(data)),
-                                           "ETag": f'"{etag}"'})
+                    headers = {"Content-Length": str(len(data)),
+                               "ETag": f'"{etag}"'}
+                    headers.update(store.meta.get((bucket, key), {}))
+                    self._reply(200, b"", headers)
                     return
 
             def _should_fail(self):
@@ -96,17 +115,7 @@ class MockS3:
                 return False
 
             def _drop_mid_body(self, status, body):
-                """Full Content-Length, half the bytes, then kill the
-                connection: the client sees IncompleteRead/reset mid-GET.
-                (shutdown(), not close(): the rfile/wfile makefile wrappers
-                hold socket refs, so close() alone never sends the FIN.)"""
-                self.send_response(status)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body[:max(1, len(body) // 2)])
-                self.wfile.flush()
-                self.close_connection = True
-                self.connection.shutdown(socket.SHUT_RDWR)
+                drop_mid_body(self, status, body)
 
             def do_GET(self):
                 if not self._check_auth():
@@ -186,10 +195,13 @@ class MockS3:
                 length = int(self.headers.get("Content-Length", 0))
                 self.rfile.read(length)
                 if "uploads" in query:
+                    meta = {k.lower(): v for k, v in self.headers.items()
+                            if k.lower().startswith("x-amz-meta-")}
                     with store.lock:
                         store.next_upload[0] += 1
                         uid = f"upload-{store.next_upload[0]}"
-                        store.uploads[uid] = {"key": (bucket, key), "parts": {}}
+                        store.uploads[uid] = {"key": (bucket, key),
+                                              "parts": {}, "meta": meta}
                     body = (f"<InitiateMultipartUploadResult>"
                             f"<UploadId>{uid}</UploadId>"
                             f"</InitiateMultipartUploadResult>").encode()
@@ -210,6 +222,7 @@ class MockS3:
                             hashlib.md5(b"".join(
                                 hashlib.md5(p).digest() for p in parts)
                             ).hexdigest() + f"-{len(parts)}")
+                        store.meta[up["key"]] = up.get("meta", {})
                         drop = store.fail_complete_once
                         store.fail_complete_once = False
                     if drop:

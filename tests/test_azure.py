@@ -96,16 +96,9 @@ class MockAzure:
                     piece, status = data[start:end + 1], 206
                 if store.drop_next_get > 0:
                     store.drop_next_get -= 1
-                    # half the body, then FIN: client sees IncompleteRead
-                    import socket as socket_mod
+                    from tests.mock_s3 import drop_mid_body
 
-                    self.send_response(status)
-                    self.send_header("Content-Length", str(len(piece)))
-                    self.end_headers()
-                    self.wfile.write(piece[:max(1, len(piece) // 2)])
-                    self.wfile.flush()
-                    self.close_connection = True
-                    self.connection.shutdown(socket_mod.SHUT_RDWR)
+                    drop_mid_body(self, status, piece)
                     return
                 self._reply(status, piece)
 
