@@ -488,6 +488,18 @@ class GBDT:
             return jax.nn.softmax(margin, axis=1)     # [B, K] probabilities
         return margin
 
+    def predict_class(self, ensemble: TreeEnsemble, bins):
+        """Hard class labels: argmax over classes (softmax) or the 0.5
+        threshold (logistic); int32 [B]."""
+        import jax.numpy as jnp
+
+        CHECK(self.param.objective != "squared",
+              "predict_class needs a classification objective")
+        margin = self.predict_margin(ensemble, bins)
+        if self.param.objective == "softmax":
+            return jnp.argmax(margin, axis=1).astype(jnp.int32)
+        return (margin > 0).astype(jnp.int32)
+
     # -- training with eval / early stopping ----------------------------------
     @functools.lru_cache(maxsize=None)
     def _tree_margin_fn(self):

@@ -245,3 +245,30 @@ def test_softmax_label_range_checked():
         m.fit_binned(bins, np.full(100, 3.0, np.float32))   # 1-indexed K
     with _pytest.raises(Exception, match="labels must lie"):
         m.fit_binned(bins, np.full(100, -1.0, np.float32))
+
+
+def test_predict_class():
+    import pytest as _pytest
+
+    rng = np.random.RandomState(5)
+    x = rng.randn(400, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    m = GBDT(GBDTParam(num_boost_round=5, max_depth=3, num_bins=16),
+             num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x))
+    ens, margin = m.fit_binned(bins, y)
+    cls = np.asarray(m.predict_class(ens, bins))
+    np.testing.assert_array_equal(cls, (np.asarray(margin) > 0).astype(int))
+
+    mc = GBDT(GBDTParam(num_boost_round=3, max_depth=3, num_bins=16,
+                        objective="softmax", num_class=3), num_feature=4)
+    mc.boundaries = m.boundaries
+    y3 = (x[:, 0] > 0).astype(np.float32) + (x[:, 1] > 0)
+    ens3, margin3 = mc.fit_binned(bins, y3)
+    cls3 = np.asarray(mc.predict_class(ens3, bins))
+    np.testing.assert_array_equal(cls3, np.asarray(margin3).argmax(1))
+
+    reg = GBDT(GBDTParam(objective="squared"), num_feature=4)
+    with _pytest.raises(Exception, match="classification"):
+        reg.predict_class(ens, bins)
