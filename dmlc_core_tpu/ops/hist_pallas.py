@@ -45,8 +45,20 @@ _INTERPRET = _os.environ.get("DMLC_TPU_PALLAS_INTERPRET",
                              "").strip().lower() in ("1", "true", "yes")
 
 # row-tile size: callers that want the wrapper's internal padding to no-op
-# (e.g. GBDT's fit-level padding) must pad to a multiple of this
-BLOCK_ROWS = 1024
+# (e.g. GBDT's fit-level padding) must pad to a multiple of this.
+# DMLC_TPU_HIST_BLOCK_ROWS overrides for on-chip tuning sweeps; 1024 is the
+# measured-best default on v5e (see BASELINE.md round-3 block_rows sweep).
+try:
+    BLOCK_ROWS = int(_os.environ.get("DMLC_TPU_HIST_BLOCK_ROWS", "") or 1024)
+except ValueError:
+    raise ValueError(
+        "DMLC_TPU_HIST_BLOCK_ROWS must be an integer multiple of the 128 "
+        f"lane width, got {_os.environ['DMLC_TPU_HIST_BLOCK_ROWS']!r}"
+    ) from None
+if BLOCK_ROWS < 128 or BLOCK_ROWS % 128:
+    raise ValueError(
+        f"DMLC_TPU_HIST_BLOCK_ROWS must be a positive multiple of the 128 "
+        f"lane width, got {BLOCK_ROWS}")
 
 
 def _bins_compare_dtype(num_bins: int):
