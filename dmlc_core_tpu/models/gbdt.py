@@ -30,9 +30,9 @@ from typing import Any, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from dmlc_core_tpu.ops.histogram import (apply_bins, bin_onehot, grad_histogram,
-                                         quantile_boundaries,
-                                         resolve_hist_method)
+from dmlc_core_tpu.ops.histogram import (apply_bins, bin_onehot,
+                                         distributed_quantile_boundaries,
+                                         grad_histogram, resolve_hist_method)
 from dmlc_core_tpu.param import Parameter, field
 from dmlc_core_tpu.utils.logging import CHECK
 
@@ -253,10 +253,22 @@ class GBDT:
         self.boundaries: Optional[np.ndarray] = None  # [F, num_bins-1]
 
     # -- binning --------------------------------------------------------------
-    def make_bins(self, sample: np.ndarray) -> np.ndarray:
-        """Fit quantile boundaries from a host sample; returns them."""
+    def make_bins(self, sample: np.ndarray, comm=None,
+                  count: Optional[int] = None) -> np.ndarray:
+        """Fit quantile boundaries from a host sample; returns them.
+
+        ``comm`` (rabit-shaped, e.g. ``dmlc_core_tpu.collective``) makes the
+        boundaries consistent across data-parallel workers via the merged
+        quantile summary (:func:`..ops.histogram.distributed_quantile_
+        boundaries`) — every rank must call with its own shard's sample.
+        Without it, each worker bins on its local sample only, which forks
+        split semantics across shards.  When ``sample`` is a capped
+        subsample of the shard, pass the shard's true row count as
+        ``count`` so imbalanced shards merge with their real mass.
+        """
         CHECK(sample.shape[1] == self.num_feature, "sample feature dim mismatch")
-        self.boundaries = quantile_boundaries(sample, self.param.num_bins)
+        self.boundaries = distributed_quantile_boundaries(
+            sample, self.param.num_bins, comm=comm, count=count)
         return self.boundaries
 
     def bin_features(self, x):

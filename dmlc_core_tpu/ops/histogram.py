@@ -34,8 +34,11 @@ from typing import Optional
 
 import numpy as np
 
+from dmlc_core_tpu.utils.logging import CHECK
+
 __all__ = ["quantile_boundaries", "apply_bins", "grad_histogram",
-           "bin_onehot", "resolve_hist_method"]
+           "bin_onehot", "resolve_hist_method", "local_quantile_summary",
+           "merged_quantile_boundaries", "distributed_quantile_boundaries"]
 
 
 def resolve_hist_method(method: str, *arrays) -> str:
@@ -85,6 +88,22 @@ def bin_onehot(bins, num_bins: int, dtype=None):
     return (bins[:, :, None] == iota).astype(dtype).reshape(B, F * num_bins)
 
 
+def _strictly_increasing(bounds: np.ndarray) -> np.ndarray:
+    """Make per-feature boundaries strictly increasing so searchsorted is
+    stable on ties (repeated quantiles from heavy-tailed or constant
+    features collapse otherwise).
+
+    The nudge is magnitude-relative: an absolute epsilon is absorbed by
+    float32 once |bound| exceeds ~1e1 (ulp(1e7) ≈ 1), which would let
+    duplicate boundaries survive on large-valued features.
+    """
+    eps = np.float32(1e-6)
+    scale = np.maximum(np.abs(bounds), np.float32(1.0))
+    return np.maximum.accumulate(
+        bounds + eps * scale * np.arange(bounds.shape[1], dtype=np.float32),
+        axis=1)
+
+
 def quantile_boundaries(sample: np.ndarray, num_bins: int) -> np.ndarray:
     """Per-feature quantile split points from a host-side sample.
 
@@ -96,12 +115,109 @@ def quantile_boundaries(sample: np.ndarray, num_bins: int) -> np.ndarray:
     sample = np.asarray(sample, dtype=np.float32)
     qs = np.linspace(0, 1, num_bins + 1)[1:-1]
     bounds = np.quantile(sample, qs, axis=0).T.astype(np.float32)  # [F, nb-1]
-    # strictly increasing boundaries keep searchsorted stable on ties
-    eps = np.float32(1e-6)
-    bounds = np.maximum.accumulate(bounds +
-                                   eps * np.arange(bounds.shape[1],
-                                                   dtype=np.float32), axis=1)
-    return bounds
+    return _strictly_increasing(bounds)
+
+
+def local_quantile_summary(sample: np.ndarray, num_points: int):
+    """Fixed-size mergeable quantile summary of one data shard.
+
+    Returns ``(points [F, num_points] float32, count int)``: the shard's
+    equi-rank quantiles plus its row count.  Every point carries mass
+    ``count / num_points``, which is all :func:`merged_quantile_boundaries`
+    needs to take weighted quantiles of a union of shards — the fixed shape
+    makes the summary allgather-able (every rank contributes the same
+    [F, K] block regardless of shard size).
+
+    An empty shard returns zero points with count 0; its mass vanishes in
+    the merge, so ranks that received no rows still participate in the
+    collective without skewing the result.
+    """
+    sample = np.asarray(sample, dtype=np.float32)
+    n, F = sample.shape
+    if n == 0:
+        return np.zeros((F, num_points), np.float32), 0
+    qs = np.linspace(0, 1, num_points)
+    points = np.quantile(sample, qs, axis=0).T.astype(np.float32)
+    return points, n
+
+
+def merged_quantile_boundaries(points: np.ndarray, counts,
+                               num_bins: int) -> np.ndarray:
+    """Merge per-shard quantile summaries into one set of bin boundaries.
+
+    Args:
+      points: [W, F, K] stacked :func:`local_quantile_summary` points from
+        all W shards (e.g. straight from ``collective.allgather``).
+      counts: [W] per-shard row counts.
+      num_bins: target bin count.
+
+    Returns boundaries [F, num_bins-1], bit-identical on every rank that
+    sees the same (points, counts) — which allgather guarantees — so
+    data-parallel workers bin consistently without shipping raw rows.  This
+    is the distributed-quantile-sketch step of XGBoost-hist (reference:
+    SURVEY.md §2.9 — the hist aggregation consumer of rabit allreduce),
+    done as one fixed-size allgather + a deterministic host merge: each
+    point of shard w carries mass ``counts[w] / K`` and the merged
+    boundary_j is the pooled weighted ``(j+1)/num_bins`` quantile
+    (inverted-CDF rule).
+    """
+    points = np.asarray(points, dtype=np.float32)
+    CHECK(points.ndim == 3, f"points must be [W, F, K], got {points.shape}")
+    W, F, K = points.shape
+    counts = np.asarray(counts, dtype=np.float64).reshape(-1)
+    CHECK(counts.shape[0] == W,
+          f"counts has {counts.shape[0]} entries for {W} summaries")
+    CHECK(counts.sum() > 0, "merged_quantile_boundaries: all shards empty")
+    # pooled points [F, W*K] with per-point mass [W*K] (shard-proportional)
+    pooled = np.swapaxes(points, 0, 1).reshape(F, W * K)
+    mass = np.repeat(counts / K, K)
+    order = np.argsort(pooled, axis=1, kind="stable")
+    v_sorted = np.take_along_axis(pooled, order, axis=1)
+    cum = np.cumsum(mass[order], axis=1)
+    total = float(counts.sum())
+    out = np.empty((F, num_bins - 1), np.float32)
+    for j in range(num_bins - 1):
+        target = total * (j + 1) / num_bins
+        idx = np.minimum((cum < target).sum(axis=1), W * K - 1)
+        out[:, j] = v_sorted[np.arange(F), idx]
+    return _strictly_increasing(out)
+
+
+def distributed_quantile_boundaries(sample: np.ndarray, num_bins: int,
+                                    comm=None,
+                                    num_points: Optional[int] = None,
+                                    count: Optional[int] = None
+                                    ) -> np.ndarray:
+    """Quantile bin boundaries consistent across data-parallel workers.
+
+    Each worker summarises its local ``sample`` (:func:`local_quantile_
+    summary`), allgathers the fixed-size summaries through ``comm`` (any
+    object with rabit-shaped ``allgather`` — e.g. ``dmlc_core_tpu.
+    collective``), and merges deterministically: all ranks return identical
+    boundaries.  With ``comm=None`` (single process) this degrades to the
+    plain :func:`quantile_boundaries`.
+
+    ``num_points`` controls summary resolution (default ``8 * num_bins``,
+    min 64): per-shard rank error is O(1/num_points), far below bin width.
+
+    ``count`` overrides the shard mass this rank contributes to the merge.
+    Pass the TRUE shard row count when ``sample`` is a capped subsample —
+    otherwise imbalanced shards are mis-weighted (a 10M-row shard sampled
+    to 100k would count the same as a full 100k shard).
+    """
+    if comm is None:
+        return quantile_boundaries(sample, num_bins)
+    K = num_points or max(64, 8 * num_bins)
+    points, n = local_quantile_summary(sample, K)
+    if count is not None:
+        CHECK(count >= 0, f"count must be non-negative, got {count}")
+        CHECK(n > 0 or count == 0,
+              f"count={count} with an empty sample contributes unsampled "
+              f"mass; pass the shard's rows (or a subsample) too")
+        n = count
+    all_points = comm.allgather(points.astype(np.float32))   # [W, F, K]
+    all_counts = comm.allgather(np.array([n], np.float32))[:, 0]
+    return merged_quantile_boundaries(all_points, all_counts, num_bins)
 
 
 def apply_bins(x, boundaries):

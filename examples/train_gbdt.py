@@ -55,6 +55,12 @@ def main():
     from dmlc_core_tpu.parallel.mesh import local_shard_info
     from dmlc_core_tpu.utils.profiler import ThroughputMeter, device_timer
 
+    # bring up the collective BEFORE sharding: under a tracker launch,
+    # jax.process_count() reflects the worker world only after
+    # collective.init() has initialized jax.distributed
+    from dmlc_core_tpu import collective
+
+    collective.init()
     part, nparts = local_shard_info()
     parser = create_parser(args.data, part, nparts, type="auto")
 
@@ -78,7 +84,12 @@ def main():
                       colsample_bytree=args.colsample_bytree, seed=args.seed,
                       objective=args.objective, num_class=args.num_class)
     model = GBDT(param, num_feature=args.num_feature)
-    model.make_bins(x[: min(len(x), 100_000)])
+    # under a multi-worker launch, merge per-shard quantile summaries so all
+    # ranks bin identically (the XGBoost distributed-sketch step)
+    comm = collective if nparts > 1 else None
+    # count=len(x): the sample may be capped but the merge must weight this
+    # shard by its true size
+    model.make_bins(x[: min(len(x), 100_000)], comm=comm, count=len(x))
     bins = np.asarray(model.bin_features(x)).astype(np.int32)
 
     (ensemble, margin), secs = device_timer(
@@ -93,6 +104,7 @@ def main():
     if args.checkpoint:
         save_checkpoint(args.checkpoint, ensemble._asdict())
         print(f"checkpoint written to {args.checkpoint}")
+    collective.finalize()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,238 @@
+"""Distributed quantile binning: mergeable summaries + consistent boundaries.
+
+The XGBoost-hist distributed-sketch step (SURVEY.md §2.9: hist aggregation
+rides rabit allreduce in the reference ecosystem), recast as one fixed-size
+allgather + deterministic host merge.  Tests cover merge accuracy vs exact
+pooled quantiles, rank-invariance, empty shards, and the end-to-end path
+through GBDT.make_bins with a fake and (in test_tracker.py style) the real
+collective.
+"""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.ops.histogram import (
+    apply_bins,
+    distributed_quantile_boundaries,
+    local_quantile_summary,
+    merged_quantile_boundaries,
+    quantile_boundaries,
+)
+
+
+class FakeComm:
+    """Rabit-shaped allgather over a preset list of per-rank values."""
+
+    def __init__(self, shards):
+        self.shards = shards          # list of per-rank local samples
+        self.calls = []
+
+    def allgather(self, value):
+        # emulate: every rank contributes its own local value; here we
+        # recompute each rank's contribution from its shard
+        self.calls.append(np.asarray(value).shape)
+        K = np.asarray(value).shape[-1] if np.asarray(value).ndim == 2 else None
+        outs = []
+        for s in self.shards:
+            if K is not None:
+                pts, _ = local_quantile_summary(s, K)
+                outs.append(pts)
+            else:
+                outs.append(np.array([len(s)], np.float32))
+        return np.stack(outs)
+
+
+def _shards(rng, sizes, F=5, scale=None):
+    out = []
+    for i, n in enumerate(sizes):
+        s = rng.randn(n, F).astype(np.float32)
+        if scale is not None:
+            s *= scale[i]            # heterogeneous shard distributions
+        out.append(s)
+    return out
+
+
+def test_merge_matches_exact_pooled_quantiles():
+    rng = np.random.RandomState(0)
+    shards = _shards(rng, [4000, 1000, 2500], scale=[1.0, 3.0, 0.5])
+    pooled = np.concatenate(shards)
+    num_bins = 32
+    K = 512
+    points = np.stack([local_quantile_summary(s, K)[0] for s in shards])
+    counts = [len(s) for s in shards]
+    merged = merged_quantile_boundaries(points, counts, num_bins)
+    exact = quantile_boundaries(pooled, num_bins)
+    # summary resolution bounds rank error by ~1/K per shard; in value
+    # space that is a fraction of a bin width
+    bin_width = (np.percentile(pooled, 97, axis=0)
+                 - np.percentile(pooled, 3, axis=0)) / num_bins
+    assert np.all(np.abs(merged - exact) < bin_width[:, None]), \
+        np.max(np.abs(merged - exact) / bin_width[:, None])
+
+
+def test_merge_bin_assignment_agrees_with_exact():
+    """The real contract: rows land in (almost) the same bins as exact
+    pooled binning."""
+    rng = np.random.RandomState(1)
+    shards = _shards(rng, [3000, 3000], F=4)
+    pooled = np.concatenate(shards)
+    num_bins = 16
+    points = np.stack([local_quantile_summary(s, 256)[0] for s in shards])
+    merged = merged_quantile_boundaries(points, [3000, 3000], num_bins)
+    exact = quantile_boundaries(pooled, num_bins)
+    b_m = np.asarray(apply_bins(pooled, merged))
+    b_e = np.asarray(apply_bins(pooled, exact))
+    agree = (b_m == b_e).mean()
+    assert agree > 0.97, f"bin agreement only {agree:.3f}"
+
+
+def test_all_ranks_get_identical_boundaries():
+    rng = np.random.RandomState(2)
+    shards = _shards(rng, [100, 5000, 700])
+    comm = FakeComm(shards)
+    per_rank = [distributed_quantile_boundaries(s, 16, comm=comm)
+                for s in shards]
+    for other in per_rank[1:]:
+        np.testing.assert_array_equal(per_rank[0], other)
+
+
+def test_empty_shard_participates_without_skew():
+    rng = np.random.RandomState(3)
+    data = rng.randn(5000, 3).astype(np.float32)
+    with_empty = FakeComm([data, np.zeros((0, 3), np.float32)])
+    alone = FakeComm([data])
+    b_with = distributed_quantile_boundaries(data, 16, comm=with_empty)
+    b_alone = distributed_quantile_boundaries(data, 16, comm=alone)
+    np.testing.assert_allclose(b_with, b_alone, atol=1e-5)
+
+
+def test_all_empty_rejected():
+    pts = np.zeros((2, 3, 64), np.float32)
+    with pytest.raises(Exception):
+        merged_quantile_boundaries(pts, [0, 0], 16)
+
+
+def test_counts_shape_mismatch_rejected():
+    pts = np.zeros((2, 3, 64), np.float32)
+    with pytest.raises(Exception):
+        merged_quantile_boundaries(pts, [1, 2, 3], 16)
+
+
+def test_comm_none_is_plain_quantiles():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1000, 4).astype(np.float32)
+    np.testing.assert_array_equal(
+        distributed_quantile_boundaries(x, 16, comm=None),
+        quantile_boundaries(x, 16))
+
+
+def test_boundaries_strictly_increasing_on_constant_feature():
+    x = np.zeros((100, 2), np.float32)
+    x[:, 1] = np.arange(100)
+    pts = np.stack([local_quantile_summary(x, 64)[0]])
+    b = merged_quantile_boundaries(pts, [100], 8)
+    assert np.all(np.diff(b, axis=1) > 0)
+
+
+def test_make_bins_with_comm():
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+    rng = np.random.RandomState(5)
+    shards = _shards(rng, [800, 1200], F=6)
+    comm = FakeComm(shards)
+    models = []
+    for s in shards:
+        m = GBDT(GBDTParam(num_boost_round=2, max_depth=3, num_bins=16),
+                 num_feature=6)
+        m.make_bins(s, comm=comm)
+        models.append(m)
+    np.testing.assert_array_equal(models[0].boundaries, models[1].boundaries)
+
+
+# --------------------------------------------- real-collective e2e ----------
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SKETCH_WORKER = r"""
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dmlc_core_tpu import collective
+from dmlc_core_tpu.ops.histogram import distributed_quantile_boundaries
+
+collective.init()
+rank = collective.get_rank()
+rng = np.random.RandomState(100 + rank)          # different data per rank
+shard = (rng.randn(1000 + 500 * rank, 4) * (1.0 + rank)).astype(np.float32)
+b = distributed_quantile_boundaries(shard, 16, comm=collective)
+np.save(os.environ["RESULT_DIR"] + f"/bounds{rank}.npy", b)
+collective.finalize()
+"""
+
+
+@pytest.mark.slow
+def test_distributed_binning_through_real_collective(tmp_path):
+    """dmlc-submit local, 2 ranks with different shards: both must derive
+    bit-identical boundaries through the real allgather."""
+    script = tmp_path / "worker.py"
+    script.write_text(SKETCH_WORKER)
+    env = os.environ.copy()
+    env["RESULT_DIR"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+           "--cluster", "local", "--num-workers", "2", "--",
+           sys.executable, str(script)]
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    b0 = np.load(tmp_path / "bounds0.npy")
+    b1 = np.load(tmp_path / "bounds1.npy")
+    np.testing.assert_array_equal(b0, b1)
+    assert np.all(np.diff(b0, axis=1) > 0)
+
+
+def test_strictness_survives_large_magnitudes():
+    """Constant feature at 1e7: an absolute epsilon is below float32 ulp
+    there; the relative nudge must still produce strictly increasing
+    boundaries."""
+    x = np.full((200, 2), 1e7, np.float32)
+    x[:, 1] = np.linspace(-1e7, 1e7, 200)
+    b = quantile_boundaries(x, 16)
+    assert np.all(np.diff(b, axis=1) > 0)
+    pts = np.stack([local_quantile_summary(x, 64)[0]])
+    bm = merged_quantile_boundaries(pts, [200], 16)
+    assert np.all(np.diff(bm, axis=1) > 0)
+
+
+def test_count_override_weights_capped_samples():
+    """A big shard summarised from a capped subsample must still dominate
+    the merge when its true count is passed."""
+    rng = np.random.RandomState(7)
+    big = (rng.randn(50_000, 2) * 10).astype(np.float32)   # wide
+    small = rng.randn(500, 2).astype(np.float32)           # narrow
+
+    class TwoRank:
+        def __init__(self):
+            self.step = 0
+
+        def allgather(self, value):
+            v = np.asarray(value)
+            if v.ndim == 2:                      # points round
+                K = v.shape[-1]
+                return np.stack([v, local_quantile_summary(small, K)[0]])
+            return np.stack([v, np.array([len(small)], np.float32)])
+
+    capped = big[:1000]                          # what the big rank samples
+    with_true_count = distributed_quantile_boundaries(
+        capped, 16, comm=TwoRank(), count=len(big))
+    exact = quantile_boundaries(np.concatenate([big, small]), 16)
+    naive = distributed_quantile_boundaries(capped, 16, comm=TwoRank())
+    err_true = np.abs(with_true_count - exact).mean()
+    err_naive = np.abs(naive - exact).mean()
+    assert err_true < err_naive, (err_true, err_naive)
