@@ -60,12 +60,22 @@ def bucket_size(n: int, minimum: int = 256) -> int:
 
 
 def block_to_dense(block: RowBlock, num_feature: int,
-                   batch_size: Optional[int] = None) -> DenseBatch:
-    """Densify a RowBlock into [B, num_feature] (B padded to batch_size)."""
+                   batch_size: Optional[int] = None,
+                   fill_value: float = 0.0) -> DenseBatch:
+    """Densify a RowBlock into [B, num_feature] (B padded to batch_size).
+
+    ``fill_value`` seeds features absent from a row: 0.0 by default
+    (classic densification), ``np.nan`` for sparsity-aware GBDT training
+    (GBDTParam.handle_missing) where absent means missing, not zero —
+    XGBoost's sparse-libsvm semantics.  Padding rows are zeroed either way
+    (they carry weight 0 and NaN would poison binning).
+    """
     n = block.size
     b = batch_size or n
     CHECK_LE(n, b, "block larger than batch_size")
-    x = np.zeros((b, num_feature), dtype=np.float32)
+    x = np.full((b, num_feature), np.float32(fill_value), dtype=np.float32)
+    if fill_value != 0.0:          # True for NaN too (NaN != 0.0)
+        x[n:] = 0.0
     nnz = block.num_nonzero
     if nnz:
         rows = np.repeat(np.arange(n), np.diff(block.offset - block.offset[0]))
@@ -136,10 +146,16 @@ class _Rebatcher:
 
 
 def dense_batches(parser: Parser, batch_size: int, num_feature: int,
-                  drop_remainder: bool = False) -> Iterator[DenseBatch]:
-    """Fixed-size dense batches from a parser (remainder zero-padded)."""
+                  drop_remainder: bool = False,
+                  fill_value: float = 0.0) -> Iterator[DenseBatch]:
+    """Fixed-size dense batches from a parser (remainder zero-padded).
+
+    ``fill_value=np.nan`` marks absent features as missing for
+    sparsity-aware GBDT training (see :func:`block_to_dense`).
+    """
     for block in _Rebatcher(parser, batch_size, drop_remainder):
-        yield block_to_dense(block, num_feature, batch_size)
+        yield block_to_dense(block, num_feature, batch_size,
+                             fill_value=fill_value)
 
 
 def sparse_batches(parser: Parser, batch_size: int,

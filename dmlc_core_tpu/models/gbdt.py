@@ -57,6 +57,10 @@ class GBDTParam(Parameter):
     colsample_bytree = field(float, default=1.0, lower=1e-6, upper=1.0,
                              help="per-tree feature subsampling rate")
     seed = field(int, default=0, help="subsampling PRNG seed")
+    handle_missing = field(bool, default=False,
+                           help="sparsity-aware splits: NaN features take a "
+                                "reserved bin and each split learns its "
+                                "default direction (XGBoost semantics)")
     objective = field(str, default="logistic",
                       enum=["logistic", "squared", "softmax"], help="loss")
     num_class = field(int, default=1, lower=1,
@@ -77,9 +81,11 @@ class TreeEnsemble(NamedTuple):
     multi:softmax layout).
     """
 
-    split_feat: Any   # [T(, K), 2**d - 1] int32, -1 = no split
-    split_bin: Any    # [T(, K), 2**d - 1] int32
-    leaf_value: Any   # [T(, K), 2**d] float32 (shrinkage already applied)
+    split_feat: Any    # [T(, K), 2**d - 1] int32, -1 = no split
+    split_bin: Any     # [T(, K), 2**d - 1] int32
+    leaf_value: Any    # [T(, K), 2**d] float32 (shrinkage already applied)
+    default_left: Any  # [T(, K), 2**d - 1] bool: missing rows go left here
+                       # (all-False without handle_missing — legacy routing)
 
     @property
     def num_trees(self) -> int:
@@ -116,12 +122,21 @@ def _softmax_grad_hess(margin, label, num_class: int):
 def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 min_child_weight: float, learning_rate: float,
                 model_axis: Optional[str] = None, method: str = "scatter",
-                onehot=None, min_split_loss: float = 0.0, feat_mask=None):
-    """Grow one tree level-by-level; returns (split_feat, split_bin, leaf_value,
-    margin_delta).  Pure jax, shapes static in (max_depth, num_bins, F).
+                onehot=None, min_split_loss: float = 0.0, feat_mask=None,
+                missing: bool = False):
+    """Grow one tree level-by-level; returns (split_feat, split_bin,
+    leaf_value, default_left, margin_delta).  Pure jax, shapes static in
+    (max_depth, num_bins, F).
 
     ``feat_mask`` ([F] bool, optional) disables features for this tree
     (colsample); ``min_split_loss`` is the XGBoost gamma pruning threshold.
+
+    ``missing=True`` is sparsity-aware split finding (XGBoost's algorithm
+    3): rows whose feature is missing carry the reserved bin
+    ``num_bins - 1``; every candidate split is scored twice from the SAME
+    cumsums — missing mass on the left vs on the right — and the better
+    direction is stored per node in ``default_left``.  The histogram
+    kernels are untouched: the missing bin is just the last bin.
     """
     import jax.numpy as jnp
 
@@ -129,8 +144,10 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     n_internal = 2 ** max_depth - 1
     split_feat = jnp.full((n_internal,), -1, dtype=jnp.int32)
     split_bin = jnp.zeros((n_internal,), dtype=jnp.int32)
+    default_left = jnp.zeros((n_internal,), dtype=jnp.bool_)
     node = jnp.zeros((B,), dtype=jnp.int32)  # node id within the level
     fiota = jnp.arange(F, dtype=jnp.int32)
+    miss_id = num_bins - 1
 
     for depth in range(max_depth):
         n_nodes = 2 ** depth
@@ -142,13 +159,31 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         HL = jnp.cumsum(H, axis=-1)
         GT = GL[..., -1:]
         HT = HL[..., -1:]
-        GR = GT - GL
-        HR = HT - HL
         lam = reg_lambda
-        gain = (GL ** 2 / (HL + lam) + GR ** 2 / (HR + lam)
-                - GT ** 2 / (HT + lam))                  # [n, F, nbins]
-        valid = (HL >= min_child_weight) & (HR >= min_child_weight)
+
+        def _gain(GLv, HLv):
+            GRv = GT - GLv
+            HRv = HT - HLv
+            gn = (GLv ** 2 / (HLv + lam) + GRv ** 2 / (HRv + lam)
+                  - GT ** 2 / (HT + lam))                # [n, F, nbins]
+            ok = (HLv >= min_child_weight) & (HRv >= min_child_weight)
+            return gn, ok
+
+        gain, valid = _gain(GL, HL)
+        if missing:
+            # default-right scored above (thresholds below the missing bin
+            # exclude its mass from GL, so it lands right for free); score
+            # default-left by shifting the missing mass into the left sums
+            gain_l, valid_l = _gain(GL + G[..., miss_id:miss_id + 1],
+                                    HL + H[..., miss_id:miss_id + 1])
+            gain = jnp.where(valid, gain, -jnp.inf)
+            gain_l = jnp.where(valid_l, gain_l, -jnp.inf)
+            go_left_default = gain_l > gain
+            gain = jnp.maximum(gain, gain_l)
+            valid = valid | valid_l
         # splitting on the last bin sends everything left: never valid
+        # (with missing handling the last REAL threshold is num_bins - 2,
+        # which separates non-missing from missing — allowed)
         valid = valid & (jnp.arange(num_bins) < num_bins - 1)[None, None, :]
         if feat_mask is not None:
             valid = valid & feat_mask[None, :, None]
@@ -160,8 +195,16 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         bb = (best % num_bins).astype(jnp.int32)
         do_split = best_gain > min_split_loss
         sf = jnp.where(do_split, bf, -1)
+        if missing:
+            dl = jnp.take_along_axis(
+                go_left_default.reshape(n_nodes, F * num_bins),
+                best[:, None], axis=-1)[:, 0] & do_split
+        else:
+            dl = jnp.zeros((n_nodes,), jnp.bool_)
         split_feat = split_feat.at[level_off + jnp.arange(n_nodes)].set(sf)
         split_bin = split_bin.at[level_off + jnp.arange(n_nodes)].set(bb)
+        default_left = default_left.at[level_off
+                                       + jnp.arange(n_nodes)].set(dl)
         # advance every row one level.  The per-row feature pick is a
         # compare-select-reduce over the (28-lane) feature axis, NOT a
         # take_along_axis gather: profiled on v5e the gather lowering costs
@@ -172,6 +215,10 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         row_bin = jnp.sum(jnp.where(nf[:, None] == fiota[None, :], bins, 0),
                           axis=1)
         go_right = (row_bin > bb[node]) & (nf >= 0)
+        if missing:
+            # missing rows sit at bin num_bins-1 > any threshold, so they
+            # already go right; default-left overrides that
+            go_right = go_right & ~((row_bin == miss_id) & dl[node])
         node = node * 2 + go_right.astype(jnp.int32)
 
     import jax
@@ -190,7 +237,7 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         Hl = jax.ops.segment_sum(h, node, num_segments=n_leaf)
     leaf_value = (-Gl / (Hl + reg_lambda)) * learning_rate
     margin_delta = leaf_value[node]
-    return split_feat, split_bin, leaf_value, margin_delta
+    return split_feat, split_bin, leaf_value, default_left, margin_delta
 
 
 def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int, class_index: int = 0):
@@ -221,8 +268,14 @@ def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int, class_index: int = 0):
     return row_w, fmask
 
 
-def _predict_tree(split_feat, split_bin, leaf_value, bins, max_depth: int):
-    """Route every row down one tree with static-depth gathers."""
+def _predict_tree(split_feat, split_bin, leaf_value, default_left, bins,
+                  max_depth: int, miss_id: int = -1):
+    """Route every row down one tree with static-depth gathers.
+
+    ``miss_id`` >= 0 enables sparsity-aware routing: rows whose split
+    feature carries that bin follow the node's learned default direction
+    instead of the threshold compare.
+    """
     import jax.numpy as jnp
 
     B, F = bins.shape
@@ -236,6 +289,9 @@ def _predict_tree(split_feat, split_bin, leaf_value, bins, max_depth: int):
         row_bin = jnp.sum(jnp.where(sf[:, None] == fiota[None, :], bins, 0),
                           axis=1)
         go_right = (row_bin > sb) & (sf >= 0)
+        if miss_id >= 0:
+            dl = default_left[level_off + node]
+            go_right = go_right & ~((row_bin == miss_id) & dl)
         node = node * 2 + go_right.astype(jnp.int32)
     return leaf_value[node]
 
@@ -267,13 +323,19 @@ class GBDT:
         ``count`` so imbalanced shards merge with their real mass.
         """
         CHECK(sample.shape[1] == self.num_feature, "sample feature dim mismatch")
+        # sparsity-aware mode reserves the last bin id for missing values:
+        # finite values quantile-bin into [0, num_bins - 2]
+        eff_bins = (self.param.num_bins - 1 if self.param.handle_missing
+                    else self.param.num_bins)
         self.boundaries = distributed_quantile_boundaries(
-            sample, self.param.num_bins, comm=comm, count=count)
+            sample, eff_bins, comm=comm, count=count)
         return self.boundaries
 
     def bin_features(self, x):
         CHECK(self.boundaries is not None, "call make_bins first")
-        return apply_bins(x, self.boundaries)
+        miss = (self.param.num_bins - 1 if self.param.handle_missing
+                else None)
+        return apply_bins(x, self.boundaries, missing_bin=miss)
 
     # -- compiled round/predict ----------------------------------------------
     def _method(self, *arrays, batch: Optional[int] = None) -> str:
@@ -330,12 +392,13 @@ class GBDT:
             h = h * weight
             onehot = (bin_onehot(bins, p.num_bins)
                       if method == "onehot" else None)
-            sf, sb, lv, delta = _build_tree(
+            sf, sb, lv, dl, delta = _build_tree(
                 bins, g, h, p.max_depth, p.num_bins, p.reg_lambda,
                 p.min_child_weight, p.learning_rate, self.model_axis,
                 method=method, onehot=onehot,
-                min_split_loss=p.min_split_loss, feat_mask=fmask)
-            return margin + delta, (sf, sb, lv)
+                min_split_loss=p.min_split_loss, feat_mask=fmask,
+                missing=p.handle_missing)
+            return margin + delta, (sf, sb, lv, dl)
 
         return jax.jit(one_round)
 
@@ -374,15 +437,17 @@ class GBDT:
                     bins_, g, h, p.max_depth, p.num_bins, p.reg_lambda,
                     p.min_child_weight, p.learning_rate, self.model_axis,
                     method=method, onehot=onehot,
-                    min_split_loss=p.min_split_loss, feat_mask=fmask)
+                    min_split_loss=p.min_split_loss, feat_mask=fmask,
+                    missing=p.handle_missing)
 
             def body(margin, rnd):
                 if K == 1:
                     row_w, fmask = _tree_sampling(p, rnd, B, bins.shape[1])
                     w = weight if row_w is None else weight * row_w
                     g, h = _grad_hess(margin, label, p.objective)
-                    sf, sb, lv, delta = grow(bins, g * w, h * w, rnd, fmask)
-                    return margin + delta, (sf, sb, lv)
+                    sf, sb, lv, dl, delta = grow(bins, g * w, h * w, rnd,
+                                                 fmask)
+                    return margin + delta, (sf, sb, lv, dl)
                 # one tree per class, all from the same margin snapshot
                 # (XGBoost multi:softmax: gradients evaluated before any of
                 # the round's K updates land) — but each tree draws its own
@@ -395,15 +460,15 @@ class GBDT:
                     w = weight if row_w is None else weight * row_w
                     trees.append(grow(bins, g_all[:, k] * w, h_all[:, k] * w,
                                       rnd, fmask))
-                delta = jnp.stack([t[3] for t in trees], axis=1)  # [B, K]
+                delta = jnp.stack([t[4] for t in trees], axis=1)  # [B, K]
                 return margin + delta, tuple(
-                    jnp.stack([t[i] for t in trees]) for i in range(3))
+                    jnp.stack([t[i] for t in trees]) for i in range(4))
 
             margin0 = jnp.zeros((B,) if K == 1 else (B, K),
                                 dtype=jnp.float32)
-            margin, (sfs, sbs, lvs) = lax.scan(
+            margin, (sfs, sbs, lvs, dls) = lax.scan(
                 body, margin0, jnp.arange(num_rounds, dtype=jnp.uint32))
-            return TreeEnsemble(sfs, sbs, lvs), margin[:n_rows]
+            return TreeEnsemble(sfs, sbs, lvs, dls), margin[:n_rows]
 
         return jax.jit(fit)
 
@@ -414,26 +479,29 @@ class GBDT:
         import jax.numpy as jnp
 
         d = self.param.max_depth
+        miss_id = (self.param.num_bins - 1 if self.param.handle_missing
+                   else -1)
 
         def predict(ensemble: TreeEnsemble, bins):
             B = bins.shape[0]
             multiclass = ensemble.split_feat.ndim == 3
 
             def body(acc, tree):
-                sf, sb, lv = tree
+                sf, sb, lv, dl = tree
                 if multiclass:
                     delta = jnp.stack(
-                        [_predict_tree(sf[k], sb[k], lv[k], bins, d)
+                        [_predict_tree(sf[k], sb[k], lv[k], dl[k], bins, d,
+                                       miss_id)
                          for k in range(sf.shape[0])], axis=1)
                 else:
-                    delta = _predict_tree(sf, sb, lv, bins, d)
+                    delta = _predict_tree(sf, sb, lv, dl, bins, d, miss_id)
                 return acc + delta, None
 
             shape = ((B, ensemble.split_feat.shape[1]) if multiclass
                      else (B,))
             out, _ = lax.scan(body, jnp.zeros(shape, jnp.float32),
                               (ensemble.split_feat, ensemble.split_bin,
-                               ensemble.leaf_value))
+                               ensemble.leaf_value, ensemble.default_left))
             return out
 
         return jax.jit(predict)
@@ -518,9 +586,11 @@ class GBDT:
         import jax
 
         d = self.param.max_depth
+        miss_id = (self.param.num_bins - 1 if self.param.handle_missing
+                   else -1)
 
-        def one_tree(sf, sb, lv, bins):
-            return _predict_tree(sf, sb, lv, bins, d)
+        def one_tree(sf, sb, lv, dl, bins):
+            return _predict_tree(sf, sb, lv, dl, bins, d, miss_id)
 
         return jax.jit(one_tree)
 
@@ -553,14 +623,15 @@ class GBDT:
         best_round, best_loss = -1, float("inf")
         tree_margin = self._tree_margin_fn()
         for r in range(self.param.num_boost_round):
-            margin, (sf, sb, lv) = self.boost_round(margin, bins, label,
-                                                    weight, round_index=r)
-            trees.append((sf, sb, lv))
+            margin, (sf, sb, lv, dl) = self.boost_round(margin, bins, label,
+                                                        weight, round_index=r)
+            trees.append((sf, sb, lv, dl))
             entry = {"round": r,
                      "train_loss": float(_logloss(margin, label,
                                                   self.param.objective))}
             if eval_margin is not None:
-                eval_margin = eval_margin + tree_margin(sf, sb, lv, eval_bins)
+                eval_margin = eval_margin + tree_margin(sf, sb, lv, dl,
+                                                       eval_bins)
                 eval_loss = float(_logloss(eval_margin, eval_label,
                                            self.param.objective))
                 entry["eval_loss"] = eval_loss
@@ -575,7 +646,8 @@ class GBDT:
         sfs = jnp.stack([t[0] for t in trees])
         sbs = jnp.stack([t[1] for t in trees])
         lvs = jnp.stack([t[2] for t in trees])
-        return TreeEnsemble(sfs, sbs, lvs), history
+        dls = jnp.stack([t[3] for t in trees])
+        return TreeEnsemble(sfs, sbs, lvs, dls), history
 
     # -- introspection / persistence ------------------------------------------
     def feature_importance(self, ensemble: TreeEnsemble,
@@ -596,7 +668,12 @@ class GBDT:
             "split_feat": np.asarray(ensemble.split_feat),
             "split_bin": np.asarray(ensemble.split_bin),
             "leaf_value": np.asarray(ensemble.leaf_value),
+            "default_left": np.asarray(ensemble.default_left),
             "boundaries": np.asarray(self.boundaries),
+            # binning contract: loading into a param with a different
+            # missing-mode would silently mis-bin NaNs and ignore the
+            # learned default directions — record it so load can refuse
+            "handle_missing": np.array([int(self.param.handle_missing)]),
         })
 
     def load_model(self, uri: str) -> TreeEnsemble:
@@ -612,8 +689,21 @@ class GBDT:
             return flat[f"['{name}']"]
 
         self.boundaries = np.asarray(get("boundaries"), dtype=np.float32)
-        return TreeEnsemble(get("split_feat"), get("split_bin"),
-                            get("leaf_value"))
+        sf = get("split_feat")
+        # models saved before sparsity-aware splits have no default_left /
+        # handle_missing keys: all-False + non-missing reproduces their
+        # exact routing
+        dl_key = "['default_left']"
+        dl = (np.asarray(flat[dl_key]).astype(bool) if dl_key in flat
+              else np.zeros(np.asarray(sf).shape, dtype=bool))
+        hm_key = "['handle_missing']"
+        saved_hm = bool(flat[hm_key][0]) if hm_key in flat else False
+        CHECK(saved_hm == self.param.handle_missing,
+              f"model was saved with handle_missing={saved_hm} but this "
+              f"GBDT has handle_missing={self.param.handle_missing}; the "
+              f"binning and routing contracts differ — construct the "
+              f"loader with the matching GBDTParam")
+        return TreeEnsemble(sf, get("split_bin"), get("leaf_value"), dl)
 
 
 def _logloss(margin, label, objective: str):

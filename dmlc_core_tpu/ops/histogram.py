@@ -114,31 +114,52 @@ def quantile_boundaries(sample: np.ndarray, num_bins: int) -> np.ndarray:
     """
     sample = np.asarray(sample, dtype=np.float32)
     qs = np.linspace(0, 1, num_bins + 1)[1:-1]
-    bounds = np.quantile(sample, qs, axis=0).T.astype(np.float32)  # [F, nb-1]
+    bounds = _nan_aware_quantile(sample, qs)             # [F, nb-1]
     return _strictly_increasing(bounds)
+
+
+def _nan_aware_quantile(sample: np.ndarray, qs: np.ndarray) -> np.ndarray:
+    """Per-feature quantiles, transposed to [F, len(qs)]; NaNs (missing
+    values under GBDTParam.handle_missing) are excluded from the ranks.
+    All-NaN features get zero boundaries (no real value to separate)."""
+    if np.isnan(sample).any():
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message="All-NaN slice")
+            out = np.nanquantile(sample, qs, axis=0).T.astype(np.float32)
+        return np.nan_to_num(out, nan=0.0)
+    return np.quantile(sample, qs, axis=0).T.astype(np.float32)
 
 
 def local_quantile_summary(sample: np.ndarray, num_points: int):
     """Fixed-size mergeable quantile summary of one data shard.
 
-    Returns ``(points [F, num_points] float32, count int)``: the shard's
-    equi-rank quantiles plus its row count.  Every point carries mass
-    ``count / num_points``, which is all :func:`merged_quantile_boundaries`
-    needs to take weighted quantiles of a union of shards — the fixed shape
-    makes the summary allgather-able (every rank contributes the same
-    [F, K] block regardless of shard size).
+    Returns ``(points [F, num_points] float32, counts [F] float32)``: the
+    shard's per-feature equi-rank quantiles plus its per-feature FINITE
+    value counts.  Every point of feature f carries mass
+    ``counts[f] / num_points``, which is all
+    :func:`merged_quantile_boundaries` needs to take weighted quantiles of
+    a union of shards — the fixed shape makes the summary allgather-able
+    (every rank contributes the same [F, K] block regardless of shard
+    size).
 
-    An empty shard returns zero points with count 0; its mass vanishes in
-    the merge, so ranks that received no rows still participate in the
+    Counts are per-feature because NaNs (missing values) carry no rank
+    mass: a feature that is entirely missing on this shard contributes
+    zero mass (its zero-filled points vanish in the merge) instead of K
+    fabricated zeros at full shard weight.  An empty shard likewise
+    returns zero points with zero counts and still participates in the
     collective without skewing the result.
     """
     sample = np.asarray(sample, dtype=np.float32)
     n, F = sample.shape
     if n == 0:
-        return np.zeros((F, num_points), np.float32), 0
+        return (np.zeros((F, num_points), np.float32),
+                np.zeros((F,), np.float32))
     qs = np.linspace(0, 1, num_points)
-    points = np.quantile(sample, qs, axis=0).T.astype(np.float32)
-    return points, n
+    points = _nan_aware_quantile(sample, qs)
+    counts = np.sum(np.isfinite(sample), axis=0).astype(np.float32)
+    return points, counts
 
 
 def merged_quantile_boundaries(points: np.ndarray, counts,
@@ -148,7 +169,8 @@ def merged_quantile_boundaries(points: np.ndarray, counts,
     Args:
       points: [W, F, K] stacked :func:`local_quantile_summary` points from
         all W shards (e.g. straight from ``collective.allgather``).
-      counts: [W] per-shard row counts.
+      counts: [W, F] per-shard per-feature finite counts (or [W] uniform
+        per-shard row counts when no values are missing).
       num_bins: target bin count.
 
     Returns boundaries [F, num_bins-1], bit-identical on every rank that
@@ -157,29 +179,34 @@ def merged_quantile_boundaries(points: np.ndarray, counts,
     is the distributed-quantile-sketch step of XGBoost-hist (reference:
     SURVEY.md §2.9 — the hist aggregation consumer of rabit allreduce),
     done as one fixed-size allgather + a deterministic host merge: each
-    point of shard w carries mass ``counts[w] / K`` and the merged
-    boundary_j is the pooled weighted ``(j+1)/num_bins`` quantile
-    (inverted-CDF rule).
+    point of shard w's feature f carries mass ``counts[w, f] / K`` and the
+    merged boundary_j is the pooled weighted ``(j+1)/num_bins`` quantile
+    per feature (inverted-CDF rule).  A feature with zero total mass (all
+    shards all-missing) gets zero boundaries — there are no real values to
+    separate.
     """
     points = np.asarray(points, dtype=np.float32)
     CHECK(points.ndim == 3, f"points must be [W, F, K], got {points.shape}")
     W, F, K = points.shape
-    counts = np.asarray(counts, dtype=np.float64).reshape(-1)
-    CHECK(counts.shape[0] == W,
-          f"counts has {counts.shape[0]} entries for {W} summaries")
+    counts = np.asarray(counts, dtype=np.float64)
+    if counts.ndim == 1:
+        counts = np.broadcast_to(counts[:, None], (W, F))
+    CHECK(counts.shape == (W, F),
+          f"counts must be [W]={W} or [W, F]={(W, F)}, got {counts.shape}")
     CHECK(counts.sum() > 0, "merged_quantile_boundaries: all shards empty")
-    # pooled points [F, W*K] with per-point mass [W*K] (shard-proportional)
+    # pooled points [F, W*K], per-point mass [F, W*K] (per-feature shard mass)
     pooled = np.swapaxes(points, 0, 1).reshape(F, W * K)
-    mass = np.repeat(counts / K, K)
+    mass = np.repeat(counts.T, K, axis=1) / K            # [F, W*K]
     order = np.argsort(pooled, axis=1, kind="stable")
     v_sorted = np.take_along_axis(pooled, order, axis=1)
-    cum = np.cumsum(mass[order], axis=1)
-    total = float(counts.sum())
+    cum = np.cumsum(np.take_along_axis(mass, order, axis=1), axis=1)
+    total = counts.sum(axis=0)                           # [F]
     out = np.empty((F, num_bins - 1), np.float32)
     for j in range(num_bins - 1):
-        target = total * (j + 1) / num_bins
-        idx = np.minimum((cum < target).sum(axis=1), W * K - 1)
+        target = total * (j + 1) / num_bins              # [F]
+        idx = np.minimum((cum < target[:, None]).sum(axis=1), W * K - 1)
         out[:, j] = v_sorted[np.arange(F), idx]
+    out[total == 0] = 0.0
     return _strictly_increasing(out)
 
 
@@ -208,22 +235,28 @@ def distributed_quantile_boundaries(sample: np.ndarray, num_bins: int,
     if comm is None:
         return quantile_boundaries(sample, num_bins)
     K = num_points or max(64, 8 * num_bins)
-    points, n = local_quantile_summary(sample, K)
+    points, fc = local_quantile_summary(sample, K)       # fc: [F] finite
+    n = np.asarray(sample).shape[0]
     if count is not None:
         CHECK(count >= 0, f"count must be non-negative, got {count}")
         CHECK(n > 0 or count == 0,
               f"count={count} with an empty sample contributes unsampled "
               f"mass; pass the shard's rows (or a subsample) too")
-        n = count
+        if n > 0:
+            # scale per-feature finite mass from the subsample up to the
+            # shard's true size (assumes missingness rates survive sampling)
+            fc = fc * (count / n)
     all_points = comm.allgather(points.astype(np.float32))   # [W, F, K]
-    all_counts = comm.allgather(np.array([n], np.float32))[:, 0]
+    all_counts = comm.allgather(fc.astype(np.float32))       # [W, F]
     return merged_quantile_boundaries(all_points, all_counts, num_bins)
 
 
-def apply_bins(x, boundaries):
+def apply_bins(x, boundaries, missing_bin: Optional[int] = None):
     """Bin dense features: x [B, F] float -> bins [B, F] int32 in [0, num_bins).
 
-    jit-safe; vmapped searchsorted over the feature axis.
+    jit-safe; vmapped searchsorted over the feature axis.  With
+    ``missing_bin`` set, NaN entries take that reserved id (sparsity-aware
+    GBDT: boundaries then cover one fewer bin, ``[F, num_bins - 2]``).
     """
     import jax
     import jax.numpy as jnp
@@ -234,7 +267,10 @@ def apply_bins(x, boundaries):
     def one_feature(col, bounds):
         return jnp.searchsorted(bounds, col, side="right").astype(jnp.int32)
 
-    return jax.vmap(one_feature, in_axes=(1, 0), out_axes=1)(x, boundaries)
+    ids = jax.vmap(one_feature, in_axes=(1, 0), out_axes=1)(x, boundaries)
+    if missing_bin is not None:
+        ids = jnp.where(jnp.isnan(x), jnp.int32(missing_bin), ids)
+    return ids
 
 
 def grad_histogram(bins, node_ids, grad, hess, num_nodes: int, num_bins: int,

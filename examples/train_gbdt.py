@@ -39,6 +39,9 @@ def main():
     ap.add_argument("--subsample", type=float, default=1.0)
     ap.add_argument("--colsample-bytree", type=float, default=1.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--handle-missing", action="store_true",
+                    help="sparsity-aware splits: absent/NaN features take "
+                         "a reserved bin with learned default directions")
     ap.add_argument("--checkpoint", default="")
     args = ap.parse_args()
 
@@ -67,7 +70,9 @@ def main():
     # materialize this shard densely (hist-GBDT trains on the binned matrix)
     meter = ThroughputMeter("ingest")
     xs, ys = [], []
-    for batch in dense_batches(parser, 8192, args.num_feature):
+    fill = np.nan if args.handle_missing else 0.0
+    for batch in dense_batches(parser, 8192, args.num_feature,
+                               fill_value=fill):
         n = int(batch.weight.sum())
         xs.append(batch.x[:n])
         ys.append(batch.label[:n])
@@ -82,7 +87,8 @@ def main():
                       min_split_loss=args.min_split_loss,
                       subsample=args.subsample,
                       colsample_bytree=args.colsample_bytree, seed=args.seed,
-                      objective=args.objective, num_class=args.num_class)
+                      objective=args.objective, num_class=args.num_class,
+                      handle_missing=args.handle_missing)
     model = GBDT(param, num_feature=args.num_feature)
     # under a multi-worker launch, merge per-shard quantile summaries so all
     # ranks bin identically (the XGBoost distributed-sketch step)

@@ -39,7 +39,9 @@ def test_fit_with_eval_tracks_losses(model_and_data):
     tm = model._tree_margin_fn()
     for t in range(ensemble.num_trees):
         incr += np.asarray(tm(ensemble.split_feat[t], ensemble.split_bin[t],
-                              ensemble.leaf_value[t], jnp.asarray(bins_v)))
+                              ensemble.leaf_value[t],
+                              ensemble.default_left[t],
+                              jnp.asarray(bins_v)))
     np.testing.assert_allclose(full, incr, rtol=1e-4, atol=1e-5)
 
 
@@ -139,9 +141,9 @@ def test_default_rates_keep_exact_legacy_behavior():
     w = jnp.ones(len(y), jnp.float32)
     trees = []
     for r in range(4):
-        margin, (sf, sb, lv) = m.boost_round(margin, jnp.asarray(bins),
-                                             jnp.asarray(y, jnp.float32), w,
-                                             round_index=r)
+        margin, (sf, sb, lv, dl) = m.boost_round(margin, jnp.asarray(bins),
+                                                 jnp.asarray(y, jnp.float32),
+                                                 w, round_index=r)
         trees.append(np.asarray(sf))
     np.testing.assert_array_equal(np.stack(trees),
                                   np.asarray(ens_fit.split_feat))

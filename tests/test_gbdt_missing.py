@@ -1,0 +1,206 @@
+"""Sparsity-aware GBDT splits: missing values with learned default
+directions (XGBoost's algorithm 3; the capability its sparse libsvm
+ingestion rests on).  Missing = NaN features -> reserved last bin; every
+split is scored with the missing mass on each side and routes missing rows
+down the better one."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+from dmlc_core_tpu.ops.histogram import apply_bins
+
+
+def _make_model(**kw):
+    kw.setdefault("num_boost_round", 5)
+    kw.setdefault("max_depth", 3)
+    kw.setdefault("num_bins", 16)
+    kw.setdefault("learning_rate", 0.5)
+    kw.setdefault("handle_missing", True)
+    num_feature = kw.pop("_F", 4)
+    return GBDT(GBDTParam(**kw), num_feature=num_feature)
+
+
+def test_apply_bins_missing_id():
+    rng = np.random.RandomState(0)
+    x = rng.randn(100, 3).astype(np.float32)
+    x[::7, 1] = np.nan
+    m = GBDT(GBDTParam(num_bins=16, handle_missing=True), num_feature=3)
+    m.make_bins(x)
+    assert m.boundaries.shape == (3, 14)     # num_bins-1 finite bins
+    bins = np.asarray(m.bin_features(x))
+    assert (bins[::7, 1] == 15).all()        # reserved last bin
+    finite = np.delete(bins, np.arange(0, 100, 7), axis=0)
+    assert finite.max() <= 14                # finite values never take it
+
+
+def test_learns_informative_missingness():
+    """Missingness itself predicts the label: rows with feature 0 missing
+    are positive.  A sparsity-aware model must exploit that; routing all
+    missing to a fixed side can't separate them from the overlapping
+    negatives."""
+    rng = np.random.RandomState(1)
+    n = 4000
+    x = rng.randn(n, 4).astype(np.float32)
+    y = (rng.rand(n) < 0.5).astype(np.float32)
+    x[y == 1, 0] = np.nan                    # positives: feature 0 missing
+    model = _make_model()
+    model.make_bins(x)
+    bins = model.bin_features(x)
+    ens, margin = model.fit_binned(bins, y)
+    acc = float(((np.asarray(margin) > 0) == y).mean())
+    assert acc > 0.99, acc
+
+
+def test_default_direction_learned_left():
+    """Construct data where the gain is higher sending missing LEFT:
+    missing rows share the label of small feature values."""
+    rng = np.random.RandomState(2)
+    n = 4000
+    x = rng.randn(n, 2).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    # knock out feature 0 on a slice of the negatives (x0 < 0 = label 0):
+    # their only recoverable signal is "missing behaves like small x0"
+    neg = np.where(y == 0)[0][:800]
+    x[neg, 0] = np.nan
+    model = _make_model(_F=2, num_boost_round=3)
+    model.make_bins(x)
+    bins = model.bin_features(x)
+    ens, margin = model.fit_binned(bins, y)
+    acc = float(((np.asarray(margin) > 0) == y).mean())
+    assert acc > 0.97, acc
+    assert bool(np.asarray(ens.default_left).any()), \
+        "expected at least one learned default-left split"
+
+
+def test_predict_matches_fit_margin_with_missing():
+    rng = np.random.RandomState(3)
+    n = 2000
+    x = rng.randn(n, 4).astype(np.float32)
+    x[rng.rand(n, 4) < 0.3] = np.nan         # 30% missing everywhere
+    w = np.array([1.5, -2.0, 0.7, 0.0], np.float32)
+    y = (np.where(np.isnan(x), 0.0, x) @ w > 0).astype(np.float32)
+    model = _make_model()
+    model.make_bins(x)
+    bins = model.bin_features(x)
+    ens, margin = model.fit_binned(bins, y)
+    pred = model.predict_margin(ens, bins)
+    np.testing.assert_allclose(np.asarray(pred), np.asarray(margin),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_save_load_roundtrip_with_default_left(tmp_path):
+    rng = np.random.RandomState(4)
+    x = rng.randn(1000, 4).astype(np.float32)
+    x[rng.rand(1000, 4) < 0.2] = np.nan
+    y = (rng.rand(1000) < (np.isnan(x[:, 0]) * 0.8 + 0.1)).astype(np.float32)
+    model = _make_model()
+    model.make_bins(x)
+    bins = model.bin_features(x)
+    ens, _ = model.fit_binned(bins, y)
+    uri = str(tmp_path / "m.bin")
+    model.save_model(uri, ens)
+    model2 = _make_model()
+    ens2 = model2.load_model(uri)
+    np.testing.assert_array_equal(np.asarray(ens.default_left),
+                                  np.asarray(ens2.default_left))
+    np.testing.assert_allclose(
+        np.asarray(model.predict_margin(ens, bins)),
+        np.asarray(model2.predict_margin(ens2, model2.bin_features(x))),
+        rtol=1e-5)
+
+
+def test_legacy_model_loads_without_default_left(tmp_path):
+    """Checkpoints written before the field exists must load with all-False
+    directions (exact legacy routing)."""
+    from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
+
+    model = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=8),
+                 num_feature=3)
+    sf = np.array([[0, 1, -1], [2, -1, -1]], np.int32)
+    sb = np.array([[3, 2, 0], [1, 0, 0]], np.int32)
+    lv = np.ones((2, 4), np.float32)
+    uri = str(tmp_path / "legacy.bin")
+    save_checkpoint(uri, {"split_feat": sf, "split_bin": sb,
+                          "leaf_value": lv,
+                          "boundaries": np.ones((3, 7), np.float32)})
+    ens = model.load_model(uri)
+    assert ens.default_left.shape == sf.shape
+    assert not ens.default_left.any()
+
+
+def test_disabled_missing_is_legacy_exact():
+    """handle_missing=False must produce bit-identical trees to the
+    pre-sparsity code path (default_left all False, same splits)."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(3000, 4).astype(np.float32)
+    y = (x[:, 0] * x[:, 1] > 0).astype(np.float32)
+    model = GBDT(GBDTParam(num_boost_round=4, max_depth=4, num_bins=32),
+                 num_feature=4)
+    model.make_bins(x)
+    bins = model.bin_features(x)
+    ens, _ = model.fit_binned(bins, y)
+    assert not np.asarray(ens.default_left).any()
+
+
+def test_missing_with_eval_and_early_stopping():
+    rng = np.random.RandomState(6)
+    n = 3000
+    x = rng.randn(n, 4).astype(np.float32)
+    x[rng.rand(n, 4) < 0.2] = np.nan
+    y = (np.isnan(x[:, 0]) | (np.nan_to_num(x[:, 1]) > 0.5)).astype(np.float32)
+    model = _make_model(num_boost_round=20)
+    model.make_bins(x[:2000])
+    bins = np.asarray(model.bin_features(x))
+    ens, hist = model.fit_with_eval(bins[:2000], y[:2000], bins[2000:],
+                                    y[2000:], early_stopping_rounds=5)
+    assert hist[-1]["eval_loss"] <= hist[0]["eval_loss"]
+
+
+def test_missing_multiclass_smoke():
+    rng = np.random.RandomState(7)
+    n = 1500
+    x = rng.randn(n, 4).astype(np.float32)
+    y = rng.randint(0, 3, n).astype(np.float32)
+    x[y == 2, 0] = np.nan                    # class 2 signalled by missing
+    model = _make_model(objective="softmax", num_class=3,
+                        num_boost_round=6)
+    model.make_bins(x)
+    bins = model.bin_features(x)
+    ens, margin = model.fit_binned(bins, y)
+    acc = float((np.asarray(margin).argmax(1) == y).mean())
+    assert acc > 0.5, acc
+    assert ens.default_left.shape == ens.split_feat.shape
+
+
+def test_dense_batches_nan_fill(tmp_path):
+    """Sparse libsvm rows densified with fill_value=nan: absent features are
+    missing, present ones keep their value, padding rows stay zero."""
+    from dmlc_core_tpu.bridge.batching import dense_batches
+    from dmlc_core_tpu.data.factory import create_parser
+
+    f = tmp_path / "t.libsvm"
+    f.write_text("1 0:1.5 2:2.5\n0 1:3.5\n")
+    parser = create_parser(str(f), 0, 1, type="auto")
+    batches = list(dense_batches(parser, 4, 3, fill_value=np.nan))
+    x = batches[0].x
+    np.testing.assert_allclose(x[0], [1.5, np.nan, 2.5])
+    np.testing.assert_allclose(x[1], [np.nan, 3.5, np.nan])
+    assert (x[2:] == 0).all()                # padding rows zero, not NaN
+    assert batches[0].weight[2:].sum() == 0
+
+
+def test_load_refuses_mismatched_missing_mode(tmp_path):
+    rng = np.random.RandomState(8)
+    x = rng.randn(500, 4).astype(np.float32)
+    x[rng.rand(500, 4) < 0.2] = np.nan
+    y = (rng.rand(500) < 0.5).astype(np.float32)
+    model = _make_model(num_boost_round=2)
+    model.make_bins(x)
+    ens, _ = model.fit_binned(model.bin_features(x), y)
+    uri = str(tmp_path / "m.bin")
+    model.save_model(uri, ens)
+    plain = GBDT(GBDTParam(num_boost_round=2, max_depth=3, num_bins=16),
+                 num_feature=4)
+    with pytest.raises(Exception, match="handle_missing"):
+        plain.load_model(uri)

@@ -29,16 +29,15 @@ class FakeComm:
 
     def allgather(self, value):
         # emulate: every rank contributes its own local value; here we
-        # recompute each rank's contribution from its shard
+        # recompute each rank's contribution from its shard.  Points travel
+        # as [F, K] (ndim 2), per-feature finite counts as [F] (ndim 1).
         self.calls.append(np.asarray(value).shape)
-        K = np.asarray(value).shape[-1] if np.asarray(value).ndim == 2 else None
+        is_points = np.asarray(value).ndim == 2
+        K = np.asarray(value).shape[-1]
         outs = []
         for s in self.shards:
-            if K is not None:
-                pts, _ = local_quantile_summary(s, K)
-                outs.append(pts)
-            else:
-                outs.append(np.array([len(s)], np.float32))
+            pts, fc = local_quantile_summary(s, K if is_points else 2)
+            outs.append(pts if is_points else fc)
         return np.stack(outs)
 
 
@@ -226,7 +225,7 @@ def test_count_override_weights_capped_samples():
             if v.ndim == 2:                      # points round
                 K = v.shape[-1]
                 return np.stack([v, local_quantile_summary(small, K)[0]])
-            return np.stack([v, np.array([len(small)], np.float32)])
+            return np.stack([v, local_quantile_summary(small, 2)[1]])
 
     capped = big[:1000]                          # what the big rank samples
     with_true_count = distributed_quantile_boundaries(
@@ -236,3 +235,32 @@ def test_count_override_weights_capped_samples():
     err_true = np.abs(with_true_count - exact).mean()
     err_naive = np.abs(naive - exact).mean()
     assert err_true < err_naive, (err_true, err_naive)
+
+
+def test_nan_shard_feature_carries_no_mass():
+    """A shard where feature f is entirely missing must not drag f's
+    merged boundaries toward its zero-filled summary points."""
+    rng = np.random.RandomState(8)
+    a = rng.randn(4000, 2).astype(np.float32) + 5.0   # values around 5
+    a[:, 1] = np.nan                                  # feature 1 all-missing
+    b = rng.randn(4000, 2).astype(np.float32) + 5.0
+    comm = FakeComm([a, b])
+    merged = distributed_quantile_boundaries(a, 16, comm=comm)
+    only_b = quantile_boundaries(b, 16)
+    # feature 1's boundaries must come from shard b alone (not be dragged
+    # halfway to zero by shard a's fabricated points)
+    np.testing.assert_allclose(merged[1], only_b[1], atol=0.2)
+    assert merged[1].min() > 3.0, merged[1]
+
+
+def test_partial_nan_weighting():
+    """Partially-missing features weight shards by finite count, not rows."""
+    rng = np.random.RandomState(9)
+    a = rng.randn(8000, 1).astype(np.float32)          # wide participation
+    a[rng.rand(8000) < 0.9, 0] = np.nan                # ...but 90% missing
+    b = (rng.randn(8000, 1) * 0.1 + 3).astype(np.float32)
+    comm = FakeComm([a, b])
+    merged = distributed_quantile_boundaries(a, 8, comm=comm)
+    # b holds ~10x the finite mass: the median boundary must sit near 3
+    mid = merged[0, len(merged[0]) // 2]
+    assert 2.5 < mid < 3.5, merged[0]
