@@ -39,6 +39,10 @@ class DenseBatch(NamedTuple):
     x: np.ndarray        # [B, F] float32
     label: np.ndarray    # [B] float32
     weight: np.ndarray   # [B] float32 (1.0 where absent; 0.0 marks padding)
+    # real (unpadded) row count; None from hand-built batches.  Consumers
+    # must slice with this, NOT weight.sum(): explicit libsvm row weights
+    # make the weight sum diverge from the row count
+    num_rows: Optional[int] = None
 
 
 class SparseBatch(NamedTuple):
@@ -48,6 +52,38 @@ class SparseBatch(NamedTuple):
     label: np.ndarray    # [B] float32
     weight: np.ndarray   # [B] float32 (0.0 marks padding rows)
     field: Optional[np.ndarray] = None  # [N] int32 (libfm)
+    num_rows: Optional[int] = None      # real row count (see DenseBatch)
+
+
+def _register_batch_pytree(cls, data_fields):
+    """Register the batch type with ``num_rows`` as STATIC aux data, not a
+    leaf: batches pass straight into jit'd steps (module docstring), where
+    a leaf row count would be a tracer — unusable for the slicing the field
+    exists for — and device loaders would have to special-case it.  As aux
+    data it stays a host int (``batch.x[:batch.num_rows]`` works under
+    jit; a changed count — e.g. the final partial batch — retraces, same
+    as any static-shape change).
+    """
+    from jax import tree_util
+
+    def flatten_with_keys(b):
+        return ([(tree_util.GetAttrKey(f), getattr(b, f))
+                 for f in data_fields], b.num_rows)
+
+    def flatten(b):
+        return [getattr(b, f) for f in data_fields], b.num_rows
+
+    def unflatten(aux, children):
+        return cls(*children, num_rows=aux)
+
+    tree_util.register_pytree_with_keys(cls, flatten_with_keys, unflatten,
+                                        flatten_func=flatten)
+
+
+_register_batch_pytree(DenseBatch, ("x", "label", "weight"))
+_register_batch_pytree(SparseBatch,
+                       ("value", "index", "row_id", "label", "weight",
+                        "field"))
 
 
 def bucket_size(n: int, minimum: int = 256) -> int:
@@ -88,7 +124,7 @@ def block_to_dense(block: RowBlock, num_feature: int,
     label[:n] = block.label
     weight = np.zeros(b, dtype=np.float32)
     weight[:n] = block.weight if block.weight is not None else 1.0
-    return DenseBatch(x, label, weight)
+    return DenseBatch(x, label, weight, num_rows=n)
 
 
 def block_to_sparse(block: RowBlock, nnz_bucket: Optional[int] = None,
@@ -116,7 +152,8 @@ def block_to_sparse(block: RowBlock, nnz_bucket: Optional[int] = None,
     if block.field is not None:
         field = np.zeros(cap, dtype=np.int32)
         field[:nnz] = block.field
-    return SparseBatch(value, index, row_id, label, weight, field)
+    return SparseBatch(value, index, row_id, label, weight, field,
+                       num_rows=n)
 
 
 class _Rebatcher:

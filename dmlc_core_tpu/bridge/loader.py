@@ -84,9 +84,7 @@ class MeshBatchLoader:
 
         mesh, axis = self._mesh, self._axis
 
-        def place(name: str, arr: np.ndarray):
-            if arr is None:
-                return None
+        def place(arr: np.ndarray):
             # batch-dim arrays shard over the data axis; nnz-dim arrays of the
             # sparse form shard likewise (each process's nonzeros stay local)
             sharding = NamedSharding(mesh, P(axis, *([None] * (arr.ndim - 1))))
@@ -94,10 +92,9 @@ class MeshBatchLoader:
             return jax.make_array_from_process_local_data(sharding, arr,
                                                           global_shape)
 
-        return type(host_batch)(*[
-            place(name, getattr(host_batch, name))
-            for name in host_batch._fields
-        ])
+        # tree_map visits only array leaves: None fields are empty subtrees
+        # and num_rows is static aux data (host-local, never device-placed)
+        return jax.tree_util.tree_map(place, host_batch)
 
     def __iter__(self) -> Iterator[Any]:
         while True:

@@ -73,7 +73,7 @@ def main():
     fill = np.nan if args.handle_missing else 0.0
     for batch in dense_batches(parser, 8192, args.num_feature,
                                fill_value=fill):
-        n = int(batch.weight.sum())
+        n = batch.num_rows
         xs.append(batch.x[:n])
         ys.append(batch.label[:n])
         meter.add(parser.bytes_read(), nrows=n)

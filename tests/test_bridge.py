@@ -311,3 +311,43 @@ def test_checkpoint_manager_wide_step_numbers(tmp_path):
     assert mgr.latest_step() == 100_000_000
     got = mgr.restore(template={"w": np.zeros(2)})
     np.testing.assert_array_equal(got["w"], np.ones(2))
+
+
+def test_num_rows_with_explicit_row_weights(tmp_path):
+    """Explicitly-weighted libsvm rows (label:weight) must not corrupt the
+    real-row count: num_rows is structural, not weight.sum()."""
+    from dmlc_core_tpu.bridge.batching import dense_batches
+    from dmlc_core_tpu.data.factory import create_parser
+
+    f = tmp_path / "w.libsvm"
+    f.write_text("1:0.5 0:1.0\n0:2.0 1:2.0\n1:0.25 0:3.0\n")
+    parser = create_parser(str(f), 0, 1, type="auto")
+    batches = list(dense_batches(parser, 8, 2))
+    b = batches[0]
+    assert b.num_rows == 3
+    assert abs(float(b.weight[:3].sum()) - 2.75) < 1e-6   # != row count
+    assert (b.weight[3:] == 0).all()
+
+
+def test_num_rows_is_static_under_jit(tmp_path):
+    """num_rows is pytree aux data: usable for slicing inside a jit'd step
+    (a leaf would be a tracer and ConcretizationTypeError here)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dmlc_core_tpu.bridge.batching import dense_batches
+    from dmlc_core_tpu.data.factory import create_parser
+
+    f = tmp_path / "t.libsvm"
+    f.write_text("1 0:1.0\n0 1:2.0\n1 0:3.0\n")
+    parser = create_parser(str(f), 0, 1, type="auto")
+    (b,) = list(dense_batches(parser, 8, 2))
+
+    @jax.jit
+    def real_label_sum(batch):
+        return jnp.sum(batch.label[:batch.num_rows])
+
+    assert float(real_label_sum(b)) == 2.0
+    # structure round-trips through tree_map with aux preserved
+    b2 = jax.tree_util.tree_map(lambda a: a, b)
+    assert b2.num_rows == 3
