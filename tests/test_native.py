@@ -159,3 +159,53 @@ def test_native_throughput_exceeds_python(tmp_path):
     n2, t_python = run(True)
     assert n1 == n2 == 60_000
     assert t_native < t_python, (t_native, t_python)
+
+
+def make_messy_libsvm(n=600, seed=0):
+    """Structurally valid but maximally messy libsvm bytes: whitespace runs,
+    tabs, CR/LF mixes, blank lines, exotic float spellings, weights on some
+    rows — the inputs real-world files actually contain."""
+    rng = np.random.RandomState(seed)
+    floats = ["1", "2.", ".5", "-0.0", "1e3", "3.14159e-2", "-7E+1",
+              "0.00001", "123456.789"]
+    lines = []
+    for i in range(n):
+        if rng.rand() < 0.05:
+            lines.append("")                       # blank line
+            continue
+        sep = "\t" if rng.rand() < 0.3 else " " * rng.randint(1, 4)
+        nnz = rng.randint(0, 6)
+        idx = sorted(rng.choice(100, size=nnz, replace=False))
+        head = floats[rng.randint(len(floats))]
+        if rng.rand() < 0.3:
+            head += f":{floats[rng.randint(len(floats))]}"
+        feats = sep.join(f"{j}:{floats[rng.randint(len(floats))]}"
+                         for j in idx)
+        tail = " " * rng.randint(0, 3)             # trailing whitespace
+        lines.append((head + sep + feats + tail))
+    eol = ["\n", "\r\n"]
+    body = "".join(l + eol[rng.randint(2)] for l in lines)
+    return body.encode()
+
+
+def test_messy_libsvm_differential_fuzz(tmp_path):
+    """Randomized differential fuzz: the C++ and numpy parsers must agree
+    row-for-row on messy (but valid) libsvm across many seeds."""
+    for seed in range(8):
+        assert_native_matches_python(tmp_path,
+                                     make_messy_libsvm(seed=seed),
+                                     "libsvm", f"messy{seed}.libsvm")
+
+
+def test_messy_csv_differential_fuzz(tmp_path):
+    floats = ["1", "2.", ".5", "-0.0", "1e3", "3.14159e-2", "-7E+1"]
+    for seed in range(4):
+        rng = np.random.RandomState(seed)
+        lines = []
+        for i in range(300):
+            vals = [floats[rng.randint(len(floats))] for _ in range(5)]
+            lines.append(",".join(vals))
+        eol = "\r\n" if seed % 2 else "\n"
+        content = (eol.join(lines) + eol).encode()
+        assert_native_matches_python(tmp_path, content, "csv",
+                                     f"messy{seed}.csv")
