@@ -86,6 +86,10 @@ class TreeEnsemble(NamedTuple):
     leaf_value: Any    # [T(, K), 2**d] float32 (shrinkage already applied)
     default_left: Any  # [T(, K), 2**d - 1] bool: missing rows go left here
                        # (all-False without handle_missing — legacy routing)
+    # split statistics for importance (XGBoost get_score analogs); None on
+    # ensembles loaded from pre-stats checkpoints — routing never reads them
+    split_gain: Any = None   # [T(, K), 2**d - 1] f32 gain, 0 where no split
+    split_cover: Any = None  # [T(, K), 2**d - 1] f32 hessian mass at node
 
     @property
     def num_trees(self) -> int:
@@ -125,8 +129,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 onehot=None, min_split_loss: float = 0.0, feat_mask=None,
                 missing: bool = False):
     """Grow one tree level-by-level; returns (split_feat, split_bin,
-    leaf_value, default_left, margin_delta).  Pure jax, shapes static in
-    (max_depth, num_bins, F).
+    leaf_value, default_left, split_gain, split_cover, margin_delta).
+    Pure jax, shapes static in (max_depth, num_bins, F).
 
     ``feat_mask`` ([F] bool, optional) disables features for this tree
     (colsample); ``min_split_loss`` is the XGBoost gamma pruning threshold.
@@ -145,6 +149,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     split_feat = jnp.full((n_internal,), -1, dtype=jnp.int32)
     split_bin = jnp.zeros((n_internal,), dtype=jnp.int32)
     default_left = jnp.zeros((n_internal,), dtype=jnp.bool_)
+    split_gain = jnp.zeros((n_internal,), dtype=jnp.float32)
+    split_cover = jnp.zeros((n_internal,), dtype=jnp.float32)
     node = jnp.zeros((B,), dtype=jnp.int32)  # node id within the level
     fiota = jnp.arange(F, dtype=jnp.int32)
     miss_id = num_bins - 1
@@ -201,10 +207,14 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 best[:, None], axis=-1)[:, 0] & do_split
         else:
             dl = jnp.zeros((n_nodes,), jnp.bool_)
-        split_feat = split_feat.at[level_off + jnp.arange(n_nodes)].set(sf)
-        split_bin = split_bin.at[level_off + jnp.arange(n_nodes)].set(bb)
-        default_left = default_left.at[level_off
-                                       + jnp.arange(n_nodes)].set(dl)
+        lvl = level_off + jnp.arange(n_nodes)
+        split_feat = split_feat.at[lvl].set(sf)
+        split_bin = split_bin.at[lvl].set(bb)
+        default_left = default_left.at[lvl].set(dl)
+        split_gain = split_gain.at[lvl].set(
+            jnp.where(do_split, best_gain, 0.0))
+        split_cover = split_cover.at[lvl].set(
+            jnp.where(do_split, HT[:, 0, 0], 0.0))
         # advance every row one level.  The per-row feature pick is a
         # compare-select-reduce over the (28-lane) feature axis, NOT a
         # take_along_axis gather: profiled on v5e the gather lowering costs
@@ -237,7 +247,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         Hl = jax.ops.segment_sum(h, node, num_segments=n_leaf)
     leaf_value = (-Gl / (Hl + reg_lambda)) * learning_rate
     margin_delta = leaf_value[node]
-    return split_feat, split_bin, leaf_value, default_left, margin_delta
+    return (split_feat, split_bin, leaf_value, default_left, split_gain,
+            split_cover, margin_delta)
 
 
 def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int, class_index: int = 0):
@@ -392,13 +403,13 @@ class GBDT:
             h = h * weight
             onehot = (bin_onehot(bins, p.num_bins)
                       if method == "onehot" else None)
-            sf, sb, lv, dl, delta = _build_tree(
+            sf, sb, lv, dl, sg, sc, delta = _build_tree(
                 bins, g, h, p.max_depth, p.num_bins, p.reg_lambda,
                 p.min_child_weight, p.learning_rate, self.model_axis,
                 method=method, onehot=onehot,
                 min_split_loss=p.min_split_loss, feat_mask=fmask,
                 missing=p.handle_missing)
-            return margin + delta, (sf, sb, lv, dl)
+            return margin + delta, (sf, sb, lv, dl, sg, sc)
 
         return jax.jit(one_round)
 
@@ -445,9 +456,9 @@ class GBDT:
                     row_w, fmask = _tree_sampling(p, rnd, B, bins.shape[1])
                     w = weight if row_w is None else weight * row_w
                     g, h = _grad_hess(margin, label, p.objective)
-                    sf, sb, lv, dl, delta = grow(bins, g * w, h * w, rnd,
-                                                 fmask)
-                    return margin + delta, (sf, sb, lv, dl)
+                    sf, sb, lv, dl, sg, sc, delta = grow(bins, g * w,
+                                                         h * w, rnd, fmask)
+                    return margin + delta, (sf, sb, lv, dl, sg, sc)
                 # one tree per class, all from the same margin snapshot
                 # (XGBoost multi:softmax: gradients evaluated before any of
                 # the round's K updates land) — but each tree draws its own
@@ -460,15 +471,16 @@ class GBDT:
                     w = weight if row_w is None else weight * row_w
                     trees.append(grow(bins, g_all[:, k] * w, h_all[:, k] * w,
                                       rnd, fmask))
-                delta = jnp.stack([t[4] for t in trees], axis=1)  # [B, K]
+                delta = jnp.stack([t[6] for t in trees], axis=1)  # [B, K]
                 return margin + delta, tuple(
-                    jnp.stack([t[i] for t in trees]) for i in range(4))
+                    jnp.stack([t[i] for t in trees]) for i in range(6))
 
             margin0 = jnp.zeros((B,) if K == 1 else (B, K),
                                 dtype=jnp.float32)
-            margin, (sfs, sbs, lvs, dls) = lax.scan(
+            margin, (sfs, sbs, lvs, dls, sgs, scs) = lax.scan(
                 body, margin0, jnp.arange(num_rounds, dtype=jnp.uint32))
-            return TreeEnsemble(sfs, sbs, lvs, dls), margin[:n_rows]
+            return (TreeEnsemble(sfs, sbs, lvs, dls, sgs, scs),
+                    margin[:n_rows])
 
         return jax.jit(fit)
 
@@ -623,9 +635,9 @@ class GBDT:
         best_round, best_loss = -1, float("inf")
         tree_margin = self._tree_margin_fn()
         for r in range(self.param.num_boost_round):
-            margin, (sf, sb, lv, dl) = self.boost_round(margin, bins, label,
-                                                        weight, round_index=r)
-            trees.append((sf, sb, lv, dl))
+            margin, (sf, sb, lv, dl, sg, sc) = self.boost_round(
+                margin, bins, label, weight, round_index=r)
+            trees.append((sf, sb, lv, dl, sg, sc))
             entry = {"round": r,
                      "train_loss": float(_logloss(margin, label,
                                                   self.param.objective))}
@@ -643,28 +655,44 @@ class GBDT:
                     history.append(entry)
                     break
             history.append(entry)
-        sfs = jnp.stack([t[0] for t in trees])
-        sbs = jnp.stack([t[1] for t in trees])
-        lvs = jnp.stack([t[2] for t in trees])
-        dls = jnp.stack([t[3] for t in trees])
-        return TreeEnsemble(sfs, sbs, lvs, dls), history
+        stacked = [jnp.stack([t[i] for t in trees]) for i in range(6)]
+        return TreeEnsemble(*stacked), history
 
     # -- introspection / persistence ------------------------------------------
     def feature_importance(self, ensemble: TreeEnsemble,
                            kind: str = "weight") -> np.ndarray:
-        """Per-feature importance: 'weight' = number of splits using the
-        feature (the XGBoost default importance_type)."""
-        CHECK(kind == "weight", "only 'weight' importance is implemented")
+        """Per-feature importance (the XGBoost importance_type set):
+        'weight' = split count, 'gain'/'total_gain' = mean/summed split
+        gain, 'cover'/'total_cover' = mean/summed hessian mass at splits.
+        Gain/cover need the split statistics recorded at fit time (absent
+        on ensembles loaded from pre-stats checkpoints)."""
+        kinds = ("weight", "gain", "total_gain", "cover", "total_cover")
+        CHECK(kind in kinds, f"importance kind {kind!r} not in {kinds}")
         sf = np.asarray(ensemble.split_feat).reshape(-1)
-        counts = np.bincount(sf[sf >= 0], minlength=self.num_feature)
-        return counts.astype(np.float64)
+        mask = sf >= 0
+        counts = np.bincount(sf[mask], minlength=self.num_feature)
+        if kind == "weight":
+            return counts.astype(np.float64)
+        stat = (ensemble.split_gain if "gain" in kind
+                else ensemble.split_cover)
+        CHECK(stat is not None,
+              f"{kind} importance needs split statistics; this ensemble "
+              f"was loaded from a checkpoint without them — refit to get "
+              f"them")
+        stat = np.asarray(stat).reshape(-1)
+        totals = np.bincount(sf[mask], weights=stat[mask],
+                             minlength=self.num_feature).astype(np.float64)
+        if kind.startswith("total_"):
+            return totals
+        return np.divide(totals, counts, out=np.zeros_like(totals),
+                         where=counts > 0)
 
     def save_model(self, uri: str, ensemble: TreeEnsemble) -> None:
         """Persist the model + binning boundaries to any URI."""
         from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
 
         CHECK(self.boundaries is not None, "model has no bin boundaries")
-        save_checkpoint(uri, {
+        payload = {
             "split_feat": np.asarray(ensemble.split_feat),
             "split_bin": np.asarray(ensemble.split_bin),
             "leaf_value": np.asarray(ensemble.leaf_value),
@@ -674,7 +702,15 @@ class GBDT:
             # missing-mode would silently mis-bin NaNs and ignore the
             # learned default directions — record it so load can refuse
             "handle_missing": np.array([int(self.param.handle_missing)]),
-        })
+        }
+        # omit absent stats (ensembles loaded from pre-stats checkpoints):
+        # np.asarray(None) would write an object-dtype leaf that can never
+        # be loaded back
+        if ensemble.split_gain is not None:
+            payload["split_gain"] = np.asarray(ensemble.split_gain)
+        if ensemble.split_cover is not None:
+            payload["split_cover"] = np.asarray(ensemble.split_cover)
+        save_checkpoint(uri, payload)
 
     def load_model(self, uri: str) -> TreeEnsemble:
         from dmlc_core_tpu.bridge.checkpoint import load_checkpoint
@@ -703,7 +739,12 @@ class GBDT:
               f"GBDT has handle_missing={self.param.handle_missing}; the "
               f"binning and routing contracts differ — construct the "
               f"loader with the matching GBDTParam")
-        return TreeEnsemble(sf, get("split_bin"), get("leaf_value"), dl)
+        def optional(name):
+            key = f"['{name}']"
+            return np.asarray(flat[key]) if key in flat else None
+
+        return TreeEnsemble(sf, get("split_bin"), get("leaf_value"), dl,
+                            optional("split_gain"), optional("split_cover"))
 
 
 def _logloss(margin, label, objective: str):

@@ -141,9 +141,10 @@ def test_default_rates_keep_exact_legacy_behavior():
     w = jnp.ones(len(y), jnp.float32)
     trees = []
     for r in range(4):
-        margin, (sf, sb, lv, dl) = m.boost_round(margin, jnp.asarray(bins),
-                                                 jnp.asarray(y, jnp.float32),
-                                                 w, round_index=r)
+        margin, tree = m.boost_round(margin, jnp.asarray(bins),
+                                     jnp.asarray(y, jnp.float32),
+                                     w, round_index=r)
+        sf = tree[0]
         trees.append(np.asarray(sf))
     np.testing.assert_array_equal(np.stack(trees),
                                   np.asarray(ens_fit.split_feat))
@@ -274,3 +275,63 @@ def test_predict_class():
     reg = GBDT(GBDTParam(objective="squared"), num_feature=4)
     with _pytest.raises(Exception, match="classification"):
         reg.predict_class(ens, bins)
+
+
+def test_gain_cover_importance(model_and_data):
+    model, bins, y, bins_v, yv = model_and_data
+    ens, _ = model.fit_binned(bins, y)
+    w = model.feature_importance(ens, "weight")
+    tg = model.feature_importance(ens, "total_gain")
+    g = model.feature_importance(ens, "gain")
+    tc = model.feature_importance(ens, "total_cover")
+    c = model.feature_importance(ens, "cover")
+    assert tg.shape == w.shape == g.shape == tc.shape == c.shape
+    assert (tg >= 0).all() and (tc >= 0).all()
+    # averages recompose into totals
+    np.testing.assert_allclose(g * w, tg, rtol=1e-6)
+    np.testing.assert_allclose(c * w, tc, rtol=1e-6)
+    # features that split at all carry positive gain
+    assert (tg[w > 0] > 0).all()
+    # model_and_data's label depends on the features: the top-gain feature
+    # must also be one that was actually split on
+    assert w[np.argmax(tg)] > 0
+
+
+def test_importance_absent_stats_errors(tmp_path, model_and_data):
+    from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
+
+    model, bins, y, _, _ = model_and_data
+    ens, _ = model.fit_binned(bins, y)
+    uri = str(tmp_path / "nostats.bin")
+    model.boundaries = model.boundaries if model.boundaries is not None \
+        else np.ones((bins.shape[1], 7), np.float32)
+    save_checkpoint(uri, {"split_feat": np.asarray(ens.split_feat),
+                          "split_bin": np.asarray(ens.split_bin),
+                          "leaf_value": np.asarray(ens.leaf_value),
+                          "boundaries": np.asarray(model.boundaries)})
+    loaded = model.load_model(uri)
+    assert loaded.split_gain is None
+    assert model.feature_importance(loaded, "weight").shape
+    with pytest.raises(Exception, match="split statistics"):
+        model.feature_importance(loaded, "gain")
+
+
+def test_save_after_stats_free_load_roundtrips(tmp_path, model_and_data):
+    """load (pre-stats checkpoint) -> save -> load must stay loadable:
+    absent stats are omitted, not serialized as object arrays."""
+    from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
+
+    model, bins, y, _, _ = model_and_data
+    ens, _ = model.fit_binned(bins, y)
+    uri = str(tmp_path / "old.bin")
+    save_checkpoint(uri, {"split_feat": np.asarray(ens.split_feat),
+                          "split_bin": np.asarray(ens.split_bin),
+                          "leaf_value": np.asarray(ens.leaf_value),
+                          "boundaries": np.asarray(model.boundaries)})
+    loaded = model.load_model(uri)
+    uri2 = str(tmp_path / "resaved.bin")
+    model.save_model(uri2, loaded)
+    again = model.load_model(uri2)
+    assert again.split_gain is None
+    np.testing.assert_array_equal(np.asarray(again.split_feat),
+                                  np.asarray(loaded.split_feat))
