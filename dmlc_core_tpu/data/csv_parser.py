@@ -26,6 +26,11 @@ class CSVParserParam(Parameter):
     format = field(str, default="csv", help="File format.")
     label_column = field(int, default=-1,
                          help="Column index that will be put into the label.")
+    missing = field(float, default=0.0,
+                    help="Value for empty cells. 0.0 matches the reference "
+                         "(its strtof parses an empty field as zero, "
+                         "csv_parser.h:83); pass nan (?missing=nan) to mark "
+                         "them missing for sparsity-aware GBDT training.")
 
 
 class CSVParser(TextParserBase):
@@ -41,7 +46,8 @@ class CSVParser(TextParserBase):
 
         if not native_bridge.available():
             return None
-        dense = native_bridge.parse_csv(data, nthread=max(self._nthread, 2))
+        dense = native_bridge.parse_csv(data, nthread=max(self._nthread, 2),
+                                        missing=self.param.missing)
         return self._from_dense(dense)
 
     def _from_dense(self, dense: np.ndarray) -> RowBlockContainer:
@@ -73,6 +79,10 @@ class CSVParser(TextParserBase):
         flat = b",".join(rows).split(b",")
         CHECK_EQ(len(flat), len(rows) * ncol,
                  "CSV rows have inconsistent column counts")
+        # empty cells take the configured missing value (reference parity:
+        # its strtof parses an empty field as 0.0, csv_parser.h:83)
+        fill = repr(float(self.param.missing)).encode()
+        flat = [c if c.strip() else fill for c in flat]
         try:
             dense = np.array(flat).astype(np.float32).reshape(len(rows), ncol)
         except ValueError as exc:

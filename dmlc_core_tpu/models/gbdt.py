@@ -720,31 +720,37 @@ class GBDT:
         # keys are jax.tree_util.keystr paths; save_model writes a flat dict,
         # so each key is exactly "['<name>']" — match it exactly (a substring
         # match would alias e.g. 'split_feat' with any future key containing
-        # that text)
-        def get(name):
-            return flat[f"['{name}']"]
+        # that text).  default=... marks keys older checkpoints lack.
+        _REQUIRED = object()
+
+        def get(name, default=_REQUIRED):
+            key = f"['{name}']"
+            if key not in flat:
+                CHECK(default is not _REQUIRED,
+                      f"checkpoint is missing required key {name!r}")
+                return default
+            return flat[key]
 
         self.boundaries = np.asarray(get("boundaries"), dtype=np.float32)
         sf = get("split_feat")
         # models saved before sparsity-aware splits have no default_left /
         # handle_missing keys: all-False + non-missing reproduces their
         # exact routing
-        dl_key = "['default_left']"
-        dl = (np.asarray(flat[dl_key]).astype(bool) if dl_key in flat
+        dl = get("default_left", default=None)
+        dl = (np.asarray(dl).astype(bool) if dl is not None
               else np.zeros(np.asarray(sf).shape, dtype=bool))
-        hm_key = "['handle_missing']"
-        saved_hm = bool(flat[hm_key][0]) if hm_key in flat else False
+        hm = get("handle_missing", default=None)
+        saved_hm = bool(hm[0]) if hm is not None else False
         CHECK(saved_hm == self.param.handle_missing,
               f"model was saved with handle_missing={saved_hm} but this "
               f"GBDT has handle_missing={self.param.handle_missing}; the "
               f"binning and routing contracts differ — construct the "
               f"loader with the matching GBDTParam")
-        def optional(name):
-            key = f"['{name}']"
-            return np.asarray(flat[key]) if key in flat else None
-
+        sg = get("split_gain", default=None)
+        sc = get("split_cover", default=None)
         return TreeEnsemble(sf, get("split_bin"), get("leaf_value"), dl,
-                            optional("split_gain"), optional("split_cover"))
+                            None if sg is None else np.asarray(sg),
+                            None if sc is None else np.asarray(sc))
 
 
 def _logloss(margin, label, objective: str):

@@ -52,11 +52,37 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
             return None
-        for name in ("dmlc_tpu_parse_libsvm", "dmlc_tpu_parse_libfm",
-                     "dmlc_tpu_parse_csv"):
+        # ABI handshake: a stale build with old entry-point signatures must
+        # not be called through mismatched ctypes prototypes — rebuild once,
+        # and disable the native path if the rebuild still disagrees
+        _ABI = 2
+        ver_fn = getattr(lib, "dmlc_tpu_abi_version", None)
+        if ver_fn is None or int(ver_fn()) != _ABI:
+            del lib
+            # unlink BEFORE rebuilding: dlopen dedups by (dev, inode), so an
+            # in-place relink would hand the second CDLL the already-mapped
+            # stale library (and rewriting a mapped ELF risks clobbering its
+            # pages); a fresh inode guarantees a fresh mapping
+            try:
+                os.unlink(_SO_PATH)
+            except OSError:
+                pass
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_SO_PATH)
+            except OSError:
+                return None
+            ver_fn = getattr(lib, "dmlc_tpu_abi_version", None)
+            if ver_fn is None or int(ver_fn()) != _ABI:
+                return None
+        for name in ("dmlc_tpu_parse_libsvm", "dmlc_tpu_parse_libfm"):
             fn = getattr(lib, name)
             fn.restype = ctypes.c_void_p
             fn.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+        lib.dmlc_tpu_parse_csv.restype = ctypes.c_void_p
+        lib.dmlc_tpu_parse_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_float]
         lib.dmlc_tpu_result_dims.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
@@ -151,11 +177,17 @@ def parse_libfm(data: bytes, nthread: int = 4):
     return offset, label, weight, index, field, value
 
 
-def parse_csv(data: bytes, nthread: int = 4) -> np.ndarray:
-    """Chunk -> dense [n_rows, n_cols] float32."""
+def parse_csv(data: bytes, nthread: int = 4,
+              missing: float = 0.0) -> np.ndarray:
+    """Chunk -> dense [n_rows, n_cols] float32.
+
+    ``missing`` fills empty cells (reference strtof-on-empty parity = 0.0;
+    NaN for sparsity-aware training).
+    """
     lib = _load()
     assert lib is not None
-    handle = lib.dmlc_tpu_parse_csv(data, len(data), nthread)
+    handle = lib.dmlc_tpu_parse_csv(data, len(data), nthread,
+                                    ctypes.c_float(missing))
     try:
         n_rows = ctypes.c_int64()
         nnz = ctypes.c_int64()

@@ -230,7 +230,8 @@ struct CsvShard {
   std::string error_msg;
 };
 
-void parse_csv_range(const char* begin, const char* end, CsvShard* s) {
+void parse_csv_range(const char* begin, const char* end, CsvShard* s,
+                     float missing) {
   const char* p = begin;
   while (p < end) {
     const char* lend = static_cast<const char*>(memchr(p, '\n', end - p));
@@ -241,7 +242,13 @@ void parse_csv_range(const char* begin, const char* end, CsvShard* s) {
       while (true) {
         q = skip_ws(q, lend);
         float v;
-        if (!parse_float(q, lend, &v)) {
+        if (q == lend || *q == ',') {
+          // empty cell: the reference's strtof parses it as 0.0 silently
+          // (src/data/csv_parser.h:83); we take the configured missing
+          // value (0.0 default = reference parity, NaN for sparsity-aware
+          // training).  A trailing comma counts as a trailing empty cell.
+          v = missing;
+        } else if (!parse_float(q, lend, &v)) {
           s->error = true;
           s->error_msg = "invalid CSV number";
           return;
@@ -335,7 +342,13 @@ void* dmlc_tpu_parse_libfm(const char* data, int64_t len, int nthread) {
   return run_parse(data, len, nthread, parse_libfm_range, true);
 }
 
-void* dmlc_tpu_parse_csv(const char* data, int64_t len, int nthread) {
+// ABI version handshake: the ctypes bridge refuses (and rebuilds) a stale
+// library whose entry points don't match what it expects.  Bump on any
+// signature change.
+int dmlc_tpu_abi_version() { return 2; }
+
+void* dmlc_tpu_parse_csv(const char* data, int64_t len, int nthread,
+                         float missing) {
   auto* result = new Result();
   result->is_dense = true;
   if (nthread < 1) nthread = 1;
@@ -345,10 +358,10 @@ void* dmlc_tpu_parse_csv(const char* data, int64_t len, int nthread) {
     std::vector<std::thread> workers;
     for (size_t i = 1; i < ranges.size(); ++i) {
       workers.emplace_back(parse_csv_range, ranges[i].first, ranges[i].second,
-                           &shards[i]);
+                           &shards[i], missing);
     }
     if (!ranges.empty()) {
-      parse_csv_range(ranges[0].first, ranges[0].second, &shards[0]);
+      parse_csv_range(ranges[0].first, ranges[0].second, &shards[0], missing);
     }
     for (auto& w : workers) w.join();
   }

@@ -209,3 +209,9 @@ def test_messy_csv_differential_fuzz(tmp_path):
         content = (eol.join(lines) + eol).encode()
         assert_native_matches_python(tmp_path, content, "csv",
                                      f"messy{seed}.csv")
+
+
+def test_csv_empty_cells_parity(tmp_path):
+    content = b"1,0.5,,2.0\n0,,1.5,\n,,,\n3,4,5,6\n"
+    # native path errors must match python: both accept empty cells as 0
+    assert_native_matches_python(tmp_path, content, "csv", "empty.csv")

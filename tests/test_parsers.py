@@ -173,3 +173,24 @@ def test_strtonum():
     assert strtonum.parse_pair(b"7") == (1, 7.0, None)
     assert strtonum.parse_pair(b"") == (0, None, None)
     assert strtonum.parse_triple(b"1:2:3.5") == (3, 1.0, 2.0, 3.5)
+
+
+def test_csv_empty_cells_default_zero(tmp_path):
+    """Reference parity: strtof parses an empty field as 0.0
+    (csv_parser.h:83) — empty cells must not error."""
+    f = tmp_path / "e.csv"
+    f.write_text("1,0.5,,2.0\n0,,1.5,\n")
+    parser = create_parser(str(f), 0, 1, type="csv")
+    rows = [r for b in parser for r in b.rows()]
+    assert len(rows) == 2
+    np.testing.assert_allclose(rows[0].value, [1.0, 0.5, 0.0, 2.0])
+    np.testing.assert_allclose(rows[1].value, [0.0, 0.0, 1.5, 0.0])
+
+
+def test_csv_missing_nan(tmp_path):
+    f = tmp_path / "m.csv"
+    f.write_text("1,0.5,\n0,,1.5\n")
+    parser = create_parser(str(f) + "?missing=nan", 0, 1, type="csv")
+    rows = [r for b in parser for r in b.rows()]
+    np.testing.assert_allclose(rows[0].value, [1.0, 0.5, np.nan])
+    np.testing.assert_allclose(rows[1].value, [0.0, np.nan, 1.5])
