@@ -21,3 +21,31 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def run_tracker_workers(tmp_path, script_text, nworkers, env_extra=None,
+                        timeout=600):
+    """Shared multi-process launch recipe: write a worker script, run it
+    under `dmlc-submit --cluster local`, return the CompletedProcess.
+
+    Used by the tracker/collective/distributed-model e2e tests so the env
+    hygiene (CPU forcing, PYTHONPATH, XLA_FLAGS scrubbing, RESULT_DIR)
+    lives in exactly one place.
+    """
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(script_text)
+    env = os.environ.copy()
+    env["RESULT_DIR"] = str(tmp_path)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    cmd = [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+           "--cluster", "local", "--num-workers", str(nworkers), "--",
+           sys.executable, str(script)]
+    return subprocess.run(cmd, env=env, cwd=repo, capture_output=True,
+                          text=True, timeout=timeout)

@@ -149,11 +149,7 @@ def test_make_bins_with_comm():
 
 
 # --------------------------------------------- real-collective e2e ----------
-import os
-import subprocess
-import sys
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests.conftest import run_tracker_workers
 
 SKETCH_WORKER = r"""
 import os
@@ -177,18 +173,7 @@ collective.finalize()
 def test_distributed_binning_through_real_collective(tmp_path):
     """dmlc-submit local, 2 ranks with different shards: both must derive
     bit-identical boundaries through the real allgather."""
-    script = tmp_path / "worker.py"
-    script.write_text(SKETCH_WORKER)
-    env = os.environ.copy()
-    env["RESULT_DIR"] = str(tmp_path)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    cmd = [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
-           "--cluster", "local", "--num-workers", "2", "--",
-           sys.executable, str(script)]
-    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
-                          text=True, timeout=300)
+    proc = run_tracker_workers(tmp_path, SKETCH_WORKER, 2, timeout=300)
     assert proc.returncode == 0, proc.stderr[-3000:]
     b0 = np.load(tmp_path / "bounds0.npy")
     b1 = np.load(tmp_path / "bounds1.npy")

@@ -217,18 +217,9 @@ collective.finalize()
 
 @pytest.mark.slow
 def test_local_backend_end_to_end(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER_SCRIPT)
-    env = os.environ.copy()
-    env["RESULT_DIR"] = str(tmp_path)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    cmd = [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
-           "--cluster", "local", "--num-workers", "2", "--",
-           sys.executable, str(script)]
-    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
-                          text=True, timeout=300)
+    from tests.conftest import run_tracker_workers
+
+    proc = run_tracker_workers(tmp_path, WORKER_SCRIPT, 2, timeout=300)
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert (tmp_path / "rank0.ok").exists()
     assert (tmp_path / "rank1.ok").exists()
@@ -306,20 +297,11 @@ collective.finalize()
 
 
 def _run_collective_workers(tmp_path, nworkers, dev_counts=""):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER_SCRIPT_V2)
-    env = os.environ.copy()
-    env["RESULT_DIR"] = str(tmp_path)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    if dev_counts:
-        env["TEST_DEV_COUNTS"] = dev_counts
-    cmd = [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
-           "--cluster", "local", "--num-workers", str(nworkers), "--",
-           sys.executable, str(script)]
-    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
-                          text=True, timeout=300)
+    from tests.conftest import run_tracker_workers
+
+    extra = {"TEST_DEV_COUNTS": dev_counts} if dev_counts else None
+    proc = run_tracker_workers(tmp_path, WORKER_SCRIPT_V2, nworkers,
+                               env_extra=extra, timeout=300)
     assert proc.returncode == 0, proc.stderr[-3000:]
     texts = set()
     for r in range(nworkers):
