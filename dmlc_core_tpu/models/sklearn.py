@@ -1,0 +1,194 @@
+"""Sklearn-style estimator facade over the jit-compiled hist GBDT.
+
+The migration surface XGBoost users actually hold: ``XGBClassifier``-shaped
+``fit(X, y)`` / ``predict`` / ``predict_proba`` / ``score`` with
+``get_params``/``set_params`` (duck-typed — no sklearn dependency), wrapping
+:class:`..models.gbdt.GBDT`.  Labels are encoded/decoded automatically,
+NaNs in ``X`` switch on sparsity-aware splits unless overridden, and
+``eval_set``/``early_stopping_rounds`` ride :meth:`GBDT.fit_with_eval`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+from dmlc_core_tpu.utils.logging import CHECK
+
+__all__ = ["GBDTClassifier", "GBDTRegressor"]
+
+# GBDTParam fields settable through the estimator constructor
+_PARAM_KEYS = ("num_boost_round", "max_depth", "num_bins", "learning_rate",
+               "reg_lambda", "min_child_weight", "min_split_loss",
+               "subsample", "colsample_bytree", "seed", "hist_method")
+
+
+class _GBDTEstimator:
+    """Shared fit/predict plumbing; subclasses fix the objective."""
+
+    def __init__(self, handle_missing: Optional[bool] = None,
+                 bin_sample_rows: int = 100_000, **params):
+        for k in params:
+            CHECK(k in _PARAM_KEYS,
+                  f"unknown parameter {k!r}; settable: {_PARAM_KEYS}")
+        self._params: Dict[str, Any] = dict(params)
+        self.handle_missing = handle_missing   # None = auto (NaN in X)
+        self.bin_sample_rows = bin_sample_rows
+
+    # -- sklearn protocol -----------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        out = dict(self._params)
+        out["handle_missing"] = self.handle_missing
+        out["bin_sample_rows"] = self.bin_sample_rows
+        return out
+
+    def set_params(self, **params):
+        for k, v in params.items():
+            if k in ("handle_missing", "bin_sample_rows"):
+                setattr(self, k, v)
+            else:
+                CHECK(k in _PARAM_KEYS, f"unknown parameter {k!r}")
+                self._params[k] = v
+        return self
+
+    # -- internals ------------------------------------------------------------
+    def _objective_params(self, y: np.ndarray) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _encode(self, y: np.ndarray) -> np.ndarray:
+        return np.asarray(y, np.float32)
+
+    def _make_model(self, X: np.ndarray, y: np.ndarray) -> GBDT:
+        missing = self.handle_missing
+        if missing is None:
+            missing = bool(np.isnan(X).any())
+        param = GBDTParam(handle_missing=missing,
+                          **self._params, **self._objective_params(y))
+        return GBDT(param, num_feature=X.shape[1])
+
+    def fit(self, X, y, sample_weight=None, eval_set=None,
+            early_stopping_rounds: int = 0, comm=None):
+        """Train; ``eval_set=(X_val, y_val)`` (or XGBoost-style
+        ``[(X_val, y_val)]``) enables loss tracking and, with
+        ``early_stopping_rounds``, best-round truncation.  ``comm``
+        (rabit-shaped) merges bin boundaries across data-parallel workers.
+        """
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        CHECK(X.ndim == 2 and len(X) == len(y),
+              f"X [{X.shape}] / y [{y.shape}] shape mismatch")
+        self.model_ = self._make_model(X, y)
+        self.model_.make_bins(X[: self.bin_sample_rows], comm=comm,
+                              count=len(X))
+        bins = self.model_.bin_features(X)
+        yy = self._encode(y)
+        if eval_set is not None:
+            # accept the XGBoost sklearn spelling eval_set=[(X, y)] too
+            if (isinstance(eval_set, (list, tuple)) and len(eval_set) == 1
+                    and isinstance(eval_set[0], (list, tuple))):
+                eval_set = eval_set[0]
+            CHECK(len(eval_set) == 2,
+                  "eval_set must be (X_val, y_val) or [(X_val, y_val)]; "
+                  "multiple eval sets are not supported")
+            CHECK(self.model_.param.objective != "softmax",
+                  "eval_set/early stopping is not implemented for "
+                  "multiclass yet (fit_with_eval tracks binary/regression "
+                  "losses); fit without eval_set")
+            Xv, yv = eval_set
+            ev_bins = self.model_.bin_features(np.asarray(Xv, np.float32))
+            self.ensemble_, self.eval_history_ = self.model_.fit_with_eval(
+                bins, yy, ev_bins, self._encode(np.asarray(yv)),
+                weight=sample_weight,
+                early_stopping_rounds=early_stopping_rounds)
+        else:
+            self.ensemble_, _ = self.model_.fit_binned(bins, yy,
+                                                       weight=sample_weight)
+            self.eval_history_ = []
+        return self
+
+    def _check_fitted(self):
+        CHECK(getattr(self, "model_", None) is not None,
+              "estimator is not fitted; call fit(X, y) first")
+
+    def _bins_for_predict(self, X):
+        self._check_fitted()
+        X = np.asarray(X, np.float32)
+        CHECK(self.model_.param.handle_missing or not np.isnan(X).any(),
+              "X contains NaN but the model was trained without missing "
+              "support (no NaN seen at fit time); refit with "
+              "handle_missing=True")
+        return self.model_.bin_features(X)
+
+    def _margin(self, X):
+        return self.model_.predict_margin(self.ensemble_,
+                                          self._bins_for_predict(X))
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        """Normalized total-gain importances (XGBoost sklearn default)."""
+        self._check_fitted()
+        imp = self.model_.feature_importance(self.ensemble_, "total_gain")
+        total = imp.sum()
+        return imp / total if total > 0 else imp
+
+    def save_model(self, uri: str) -> None:
+        self._check_fitted()
+        self.model_.save_model(uri, self.ensemble_)
+
+
+class GBDTClassifier(_GBDTEstimator):
+    """Binary or multiclass classifier (objective auto-selected from y)."""
+
+    def _objective_params(self, y: np.ndarray) -> Dict[str, Any]:
+        self.classes_ = np.unique(y)
+        CHECK(len(self.classes_) >= 2,
+              f"need >= 2 classes, got {self.classes_!r}")
+        if len(self.classes_) == 2:
+            return {"objective": "logistic"}
+        return {"objective": "softmax", "num_class": len(self.classes_)}
+
+    def _encode(self, y: np.ndarray) -> np.ndarray:
+        # map original labels to 0..K-1 ids; labels unseen at fit time must
+        # error, not silently take an arbitrary insertion index
+        unseen = ~np.isin(y, self.classes_)
+        CHECK(not unseen.any(),
+              f"labels {np.unique(np.asarray(y)[unseen])!r} were not in "
+              f"the training classes {self.classes_!r}")
+        return np.searchsorted(self.classes_, y).astype(np.float32)
+
+    def predict(self, X) -> np.ndarray:
+        bins = self._bins_for_predict(X)       # validates fitted state first
+        ids = np.asarray(self.model_.predict_class(self.ensemble_, bins))
+        return self.classes_[ids]
+
+    def predict_proba(self, X) -> np.ndarray:
+        bins = self._bins_for_predict(X)
+        proba = np.asarray(self.model_.predict(self.ensemble_, bins),
+                           np.float64)
+        if proba.ndim == 2:                    # softmax [B, K]
+            return proba
+        return np.stack([1.0 - proba, proba], axis=1)   # logistic
+
+    def score(self, X, y) -> float:
+        """Mean accuracy."""
+        return float((self.predict(X) == np.asarray(y)).mean())
+
+
+class GBDTRegressor(_GBDTEstimator):
+    """Squared-error regressor."""
+
+    def _objective_params(self, y: np.ndarray) -> Dict[str, Any]:
+        return {"objective": "squared"}
+
+    def predict(self, X) -> np.ndarray:
+        return np.asarray(self._margin(X))
+
+    def score(self, X, y) -> float:
+        """R^2 (coefficient of determination), the sklearn convention."""
+        y = np.asarray(y, np.float64)
+        pred = np.asarray(self.predict(X), np.float64)
+        ss_res = float(((y - pred) ** 2).sum())
+        ss_tot = float(((y - y.mean()) ** 2).sum())
+        return 1.0 - ss_res / ss_tot if ss_tot > 0 else 0.0

@@ -1,0 +1,156 @@
+"""Sklearn-facade estimators over the hist GBDT."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.models.sklearn import GBDTClassifier, GBDTRegressor
+
+
+def _binary(n=3000, F=6, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, F).astype(np.float32)
+    w = rng.randn(F)
+    y = (x @ w > 0).astype(int)
+    return x, y
+
+
+def test_classifier_binary():
+    x, y = _binary()
+    clf = GBDTClassifier(num_boost_round=10, max_depth=4, num_bins=32,
+                         learning_rate=0.5)
+    clf.fit(x, y)
+    assert clf.score(x, y) > 0.95
+    proba = clf.predict_proba(x)
+    assert proba.shape == (len(x), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    assert set(np.unique(clf.predict(x))) <= {0, 1}
+
+
+def test_classifier_multiclass_string_labels():
+    rng = np.random.RandomState(1)
+    n = 2000
+    x = rng.randn(n, 4).astype(np.float32)
+    labels = np.array(["cat", "dog", "fish"])
+    y = labels[(x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)]
+    clf = GBDTClassifier(num_boost_round=8, max_depth=4, num_bins=32,
+                         learning_rate=0.5)
+    clf.fit(x, y)
+    assert list(clf.classes_) == ["cat", "dog", "fish"]
+    pred = clf.predict(x)
+    assert set(pred) <= set(labels)
+    assert (pred == y).mean() > 0.9
+    proba = clf.predict_proba(x)
+    assert proba.shape == (n, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_regressor_r2():
+    rng = np.random.RandomState(2)
+    n = 3000
+    x = rng.randn(n, 5).astype(np.float32)
+    y = x[:, 0] * 2 - x[:, 1] + 0.1 * rng.randn(n)
+    reg = GBDTRegressor(num_boost_round=20, max_depth=4, num_bins=64,
+                        learning_rate=0.3)
+    reg.fit(x, y)
+    assert reg.score(x, y) > 0.8
+
+
+def test_nan_autoselects_missing_mode():
+    x, y = _binary(seed=3)
+    x[::5, 0] = np.nan
+    clf = GBDTClassifier(num_boost_round=5, max_depth=3, num_bins=16)
+    clf.fit(x, y)
+    assert clf.model_.param.handle_missing is True
+    assert np.isfinite(clf.predict_proba(x)).all()
+    # explicit override wins
+    clf2 = GBDTClassifier(num_boost_round=2, max_depth=2, num_bins=16,
+                          handle_missing=False)
+    clf2.fit(np.nan_to_num(x), y)
+    assert clf2.model_.param.handle_missing is False
+
+
+def test_eval_set_early_stopping():
+    x, y = _binary(n=4000, seed=4)
+    clf = GBDTClassifier(num_boost_round=40, max_depth=3, num_bins=32,
+                         learning_rate=0.8)
+    clf.fit(x[:3000], y[:3000], eval_set=(x[3000:], y[3000:]),
+            early_stopping_rounds=5)
+    assert clf.eval_history_
+    assert "eval_loss" in clf.eval_history_[0]
+    assert clf.ensemble_.num_trees <= 40
+
+
+def test_feature_importances_normalized():
+    x, y = _binary()
+    clf = GBDTClassifier(num_boost_round=5, max_depth=3, num_bins=32)
+    clf.fit(x, y)
+    imp = clf.feature_importances_
+    assert imp.shape == (x.shape[1],)
+    assert abs(imp.sum() - 1.0) < 1e-6
+    assert (imp >= 0).all()
+
+
+def test_get_set_params_roundtrip():
+    clf = GBDTClassifier(num_boost_round=7, max_depth=5)
+    p = clf.get_params()
+    assert p["num_boost_round"] == 7 and p["max_depth"] == 5
+    clf.set_params(max_depth=3, handle_missing=True)
+    assert clf.get_params()["max_depth"] == 3
+    assert clf.get_params()["handle_missing"] is True
+    with pytest.raises(Exception):
+        clf.set_params(bogus=1)
+    with pytest.raises(Exception):
+        GBDTClassifier(bogus=1)
+
+
+def test_unfitted_raises():
+    with pytest.raises(Exception, match="not fitted"):
+        GBDTClassifier().predict(np.zeros((2, 2), np.float32))
+
+
+def test_save_model_interops_with_low_level(tmp_path):
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+    x, y = _binary(seed=5)
+    clf = GBDTClassifier(num_boost_round=4, max_depth=3, num_bins=16)
+    clf.fit(x, y)
+    uri = str(tmp_path / "m.bin")
+    clf.save_model(uri)
+    low = GBDT(GBDTParam(num_boost_round=4, max_depth=3, num_bins=16),
+               num_feature=x.shape[1])
+    ens = low.load_model(uri)
+    margin = np.asarray(low.predict_margin(ens, low.bin_features(x)))
+    np.testing.assert_allclose(margin, np.asarray(clf._margin(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_eval_set_list_form_and_multiclass_guard():
+    x, y = _binary(n=2000, seed=6)
+    clf = GBDTClassifier(num_boost_round=4, max_depth=3, num_bins=16)
+    clf.fit(x[:1500], y[:1500], eval_set=[(x[1500:], y[1500:])])
+    assert "eval_loss" in clf.eval_history_[0]
+    # multiclass + eval_set: clear error, not a confusing internal CHECK
+    rng = np.random.RandomState(7)
+    x3 = rng.randn(600, 3).astype(np.float32)
+    y3 = rng.randint(0, 3, 600)
+    with pytest.raises(Exception, match="multiclass"):
+        GBDTClassifier(num_boost_round=2, max_depth=2, num_bins=8).fit(
+            x3, y3, eval_set=(x3, y3))
+
+
+def test_unseen_eval_labels_rejected():
+    x, y = _binary(n=1000, seed=8)
+    clf = GBDTClassifier(num_boost_round=2, max_depth=2, num_bins=8)
+    with pytest.raises(Exception, match="not in"):
+        clf.fit(x[:800], y[:800],
+                eval_set=(x[800:], np.full(200, 7)))
+
+
+def test_nan_at_predict_without_missing_support_rejected():
+    x, y = _binary(n=1000, seed=9)
+    clf = GBDTClassifier(num_boost_round=2, max_depth=2, num_bins=8)
+    clf.fit(x, y)                    # dense fit -> missing mode off
+    x_bad = x.copy()
+    x_bad[0, 0] = np.nan
+    with pytest.raises(Exception, match="handle_missing"):
+        clf.predict(x_bad)
