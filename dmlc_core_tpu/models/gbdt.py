@@ -123,6 +123,17 @@ def _softmax_grad_hess(margin, label, num_class: int):
     return pr - onehot, jnp.maximum(2.0 * pr * (1.0 - pr), 1e-16)
 
 
+def _check_softmax_labels(label, num_class: int, what: str = "labels"):
+    """Host-side class-id range check shared by every softmax entry point:
+    out-of-range ids silently clamp under jit (take_along_axis / one-hot),
+    so they must be rejected before tracing."""
+    host = np.asarray(label)
+    CHECK(host.size == 0
+          or (host.min() >= 0 and host.max() < num_class),
+          f"softmax {what} must lie in [0, {num_class}); "
+          f"got range [{host.min()}, {host.max()}]")
+
+
 def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 min_child_weight: float, learning_rate: float,
                 model_axis: Optional[str] = None, method: str = "scatter",
@@ -279,6 +290,27 @@ def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int, class_index: int = 0):
     return row_w, fmask
 
 
+def _softmax_round(p, bins, margin, label, weight, rnd, grow):
+    """One multiclass boosting round: K trees from one margin snapshot
+    (XGBoost multi:softmax — gradients evaluated before any of the round's
+    K updates land), each tree drawing its own row/feature subset.
+    ``grow`` is the caller's _build_tree closure."""
+    import jax.numpy as jnp
+
+    K = p.num_class
+    g_all, h_all = _softmax_grad_hess(margin, label, K)
+    trees = []
+    for k in range(K):
+        row_w, fmask = _tree_sampling(p, rnd, bins.shape[0], bins.shape[1],
+                                      class_index=k)
+        w = weight if row_w is None else weight * row_w
+        trees.append(grow(bins, g_all[:, k] * w, h_all[:, k] * w, rnd,
+                          fmask))
+    delta = jnp.stack([t[6] for t in trees], axis=1)     # [B, K]
+    return margin + delta, tuple(
+        jnp.stack([t[i] for t in trees]) for i in range(6))
+
+
 def _predict_tree(split_feat, split_bin, leaf_value, default_left, bins,
                   max_depth: int, miss_id: int = -1):
     """Route every row down one tree with static-depth gathers.
@@ -394,21 +426,27 @@ class GBDT:
         p = self.param
 
         def one_round(margin, bins, label, weight, rnd):
+            onehot = (bin_onehot(bins, p.num_bins)
+                      if method == "onehot" else None)
+
+            def grow(bins_, g, h, rnd_, fmask):
+                return _build_tree(
+                    bins_, g, h, p.max_depth, p.num_bins, p.reg_lambda,
+                    p.min_child_weight, p.learning_rate, self.model_axis,
+                    method=method, onehot=onehot,
+                    min_split_loss=p.min_split_loss, feat_mask=fmask,
+                    missing=p.handle_missing)
+
+            if p.objective == "softmax":
+                return _softmax_round(p, bins, margin, label, weight, rnd,
+                                      grow)
             g, h = _grad_hess(margin, label, p.objective)
             row_w, fmask = _tree_sampling(p, rnd, bins.shape[0],
                                           bins.shape[1])
             if row_w is not None:
                 weight = weight * row_w
-            g = g * weight
-            h = h * weight
-            onehot = (bin_onehot(bins, p.num_bins)
-                      if method == "onehot" else None)
-            sf, sb, lv, dl, sg, sc, delta = _build_tree(
-                bins, g, h, p.max_depth, p.num_bins, p.reg_lambda,
-                p.min_child_weight, p.learning_rate, self.model_axis,
-                method=method, onehot=onehot,
-                min_split_loss=p.min_split_loss, feat_mask=fmask,
-                missing=p.handle_missing)
+            sf, sb, lv, dl, sg, sc, delta = grow(bins, g * weight,
+                                                 h * weight, rnd, fmask)
             return margin + delta, (sf, sb, lv, dl, sg, sc)
 
         return jax.jit(one_round)
@@ -459,21 +497,8 @@ class GBDT:
                     sf, sb, lv, dl, sg, sc, delta = grow(bins, g * w,
                                                          h * w, rnd, fmask)
                     return margin + delta, (sf, sb, lv, dl, sg, sc)
-                # one tree per class, all from the same margin snapshot
-                # (XGBoost multi:softmax: gradients evaluated before any of
-                # the round's K updates land) — but each tree draws its own
-                # row/feature subset
-                g_all, h_all = _softmax_grad_hess(margin, label, K)
-                trees = []
-                for k in range(K):
-                    row_w, fmask = _tree_sampling(p, rnd, B, bins.shape[1],
-                                                  class_index=k)
-                    w = weight if row_w is None else weight * row_w
-                    trees.append(grow(bins, g_all[:, k] * w, h_all[:, k] * w,
-                                      rnd, fmask))
-                delta = jnp.stack([t[6] for t in trees], axis=1)  # [B, K]
-                return margin + delta, tuple(
-                    jnp.stack([t[i] for t in trees]) for i in range(6))
+                return _softmax_round(p, bins, margin, label, weight, rnd,
+                                      grow)
 
             margin0 = jnp.zeros((B,) if K == 1 else (B, K),
                                 dtype=jnp.float32)
@@ -524,12 +549,7 @@ class GBDT:
         import jax.numpy as jnp
 
         if self.param.objective == "softmax":
-            host_labels = np.asarray(label)
-            CHECK(host_labels.size == 0
-                  or (host_labels.min() >= 0
-                      and host_labels.max() < self.param.num_class),
-                  f"softmax labels must lie in [0, {self.param.num_class}); "
-                  f"got range [{host_labels.min()}, {host_labels.max()}]")
+            _check_softmax_labels(label, self.param.num_class)
         weight = (jnp.ones(bins.shape[0], jnp.float32)
                   if weight is None else jnp.asarray(weight))
         bins = jnp.asarray(bins)
@@ -552,8 +572,6 @@ class GBDT:
         """
         import jax.numpy as jnp
 
-        CHECK(self.param.objective != "softmax",
-              "softmax trains K trees per round: use fit_binned")
         if round_index is None:
             CHECK(self.param.subsample >= 1.0
                   and self.param.colsample_bytree >= 1.0,
@@ -617,19 +635,25 @@ class GBDT:
         """
         import jax.numpy as jnp
 
-        CHECK(self.param.objective != "softmax",
-              "fit_with_eval tracks binary/regression losses; train "
-              "softmax models with fit_binned")
+        K = (self.param.num_class if self.param.objective == "softmax"
+             else 1)
+        if K > 1:
+            _check_softmax_labels(label, K)
+            if eval_label is not None:
+                _check_softmax_labels(eval_label, K, what="eval labels")
         weight = (jnp.ones(bins.shape[0], jnp.float32)
                   if weight is None else jnp.asarray(weight))
         bins = jnp.asarray(bins)
         label = jnp.asarray(label, jnp.float32)
-        margin = jnp.zeros(bins.shape[0], jnp.float32)
+        mshape = (bins.shape[0],) if K == 1 else (bins.shape[0], K)
+        margin = jnp.zeros(mshape, jnp.float32)
         eval_margin = None
         if eval_bins is not None:
             eval_bins = jnp.asarray(eval_bins)
             eval_label = jnp.asarray(eval_label, jnp.float32)
-            eval_margin = jnp.zeros(eval_bins.shape[0], jnp.float32)
+            eshape = ((eval_bins.shape[0],) if K == 1
+                      else (eval_bins.shape[0], K))
+            eval_margin = jnp.zeros(eshape, jnp.float32)
         trees = []
         history = []
         best_round, best_loss = -1, float("inf")
@@ -642,8 +666,14 @@ class GBDT:
                      "train_loss": float(_logloss(margin, label,
                                                   self.param.objective))}
             if eval_margin is not None:
-                eval_margin = eval_margin + tree_margin(sf, sb, lv, dl,
-                                                       eval_bins)
+                if K == 1:
+                    delta = tree_margin(sf, sb, lv, dl, eval_bins)
+                else:
+                    # softmax rounds carry K trees: [K, ...] arrays
+                    delta = jnp.stack(
+                        [tree_margin(sf[k], sb[k], lv[k], dl[k], eval_bins)
+                         for k in range(K)], axis=1)
+                eval_margin = eval_margin + delta
                 eval_loss = float(_logloss(eval_margin, eval_label,
                                            self.param.objective))
                 entry["eval_loss"] = eval_loss
@@ -754,8 +784,15 @@ class GBDT:
 
 
 def _logloss(margin, label, objective: str):
+    import jax
     import jax.numpy as jnp
 
     if objective == "logistic":
         return jnp.mean(jnp.logaddexp(0.0, margin) - label * margin)
+    if objective == "softmax":
+        # mlogloss: mean cross-entropy of the true class
+        logp = jax.nn.log_softmax(margin, axis=1)
+        ids = label.astype(jnp.int32)
+        return -jnp.mean(jnp.take_along_axis(logp, ids[:, None],
+                                             axis=1)[:, 0])
     return jnp.mean((margin - label) ** 2)

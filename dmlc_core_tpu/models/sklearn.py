@@ -5,7 +5,8 @@ The migration surface XGBoost users actually hold: ``XGBClassifier``-shaped
 ``get_params``/``set_params`` (duck-typed — no sklearn dependency), wrapping
 :class:`..models.gbdt.GBDT`.  Labels are encoded/decoded automatically,
 NaNs in ``X`` switch on sparsity-aware splits unless overridden, and
-``eval_set``/``early_stopping_rounds`` ride :meth:`GBDT.fit_with_eval`.
+``eval_set``/``early_stopping_rounds`` ride :meth:`GBDT.fit_with_eval`
+(binary logloss, squared error, or multiclass mlogloss per objective).
 """
 
 from __future__ import annotations
@@ -92,10 +93,6 @@ class _GBDTEstimator:
             CHECK(len(eval_set) == 2,
                   "eval_set must be (X_val, y_val) or [(X_val, y_val)]; "
                   "multiple eval sets are not supported")
-            CHECK(self.model_.param.objective != "softmax",
-                  "eval_set/early stopping is not implemented for "
-                  "multiclass yet (fit_with_eval tracks binary/regression "
-                  "losses); fit without eval_set")
             Xv, yv = eval_set
             ev_bins = self.model_.bin_features(np.asarray(Xv, np.float32))
             self.ensemble_, self.eval_history_ = self.model_.fit_with_eval(

@@ -229,10 +229,14 @@ def test_softmax_guards():
 
     with _pytest.raises(Exception, match="num_class"):
         GBDT(GBDTParam(objective="softmax"), num_feature=4)
-    m = GBDT(GBDTParam(objective="softmax", num_class=3), num_feature=4)
-    with _pytest.raises(Exception, match="fit_binned"):
-        m.boost_round(jnp.zeros((8, 3)), jnp.zeros((8, 4), jnp.int32),
-                      jnp.zeros(8), jnp.ones(8))
+    # softmax boost_round is supported (K trees per round, [K, ...] arrays)
+    m = GBDT(GBDTParam(objective="softmax", num_class=3, max_depth=2,
+                       num_bins=8), num_feature=4)
+    margin, tree = m.boost_round(jnp.zeros((8, 3)), jnp.zeros((8, 4),
+                                                              jnp.int32),
+                                 jnp.zeros(8), jnp.ones(8))
+    assert margin.shape == (8, 3)
+    assert tree[0].shape[0] == 3          # split_feat [K, n_internal]
 
 
 def test_softmax_label_range_checked():
@@ -335,3 +339,47 @@ def test_save_after_stats_free_load_roundtrips(tmp_path, model_and_data):
     assert again.split_gain is None
     np.testing.assert_array_equal(np.asarray(again.split_feat),
                                   np.asarray(loaded.split_feat))
+
+
+def test_softmax_fit_with_eval_matches_fit_binned():
+    """Multiclass round-by-round path must produce the same ensemble as the
+    scan path at default rates, with decreasing mlogloss."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(11)
+    n = 1500
+    x = rng.randn(n, 4).astype(np.float32)
+    y = ((x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)).astype(np.float32)
+    m = GBDT(GBDTParam(num_boost_round=5, max_depth=3, num_bins=16,
+                       objective="softmax", num_class=3, learning_rate=0.5),
+             num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x), np.int32)
+    ens_scan, _ = m.fit_binned(bins, y)
+    ens_iter, hist = m.fit_with_eval(bins, y, bins, y)
+    np.testing.assert_array_equal(np.asarray(ens_scan.split_feat),
+                                  np.asarray(ens_iter.split_feat))
+    np.testing.assert_allclose(np.asarray(ens_scan.leaf_value),
+                               np.asarray(ens_iter.leaf_value),
+                               rtol=1e-5, atol=1e-6)
+    assert hist[-1]["eval_loss"] < hist[0]["eval_loss"]
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+
+
+def test_softmax_fit_with_eval_label_range_checked():
+    m = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=8,
+                       objective="softmax", num_class=3),
+             num_feature=2)
+    bins = np.zeros((10, 2), np.int32)
+    with pytest.raises(Exception, match="softmax labels"):
+        m.fit_with_eval(bins, np.full(10, 5.0, np.float32))
+
+
+def test_softmax_eval_label_range_checked():
+    m = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=8,
+                       objective="softmax", num_class=3),
+             num_feature=2)
+    bins = np.zeros((10, 2), np.int32)
+    good = np.zeros(10, np.float32)
+    with pytest.raises(Exception, match="eval labels"):
+        m.fit_with_eval(bins, good, bins, np.full(10, 4.0, np.float32))

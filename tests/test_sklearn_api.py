@@ -124,18 +124,22 @@ def test_save_model_interops_with_low_level(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
-def test_eval_set_list_form_and_multiclass_guard():
+def test_eval_set_list_form_and_multiclass():
     x, y = _binary(n=2000, seed=6)
     clf = GBDTClassifier(num_boost_round=4, max_depth=3, num_bins=16)
     clf.fit(x[:1500], y[:1500], eval_set=[(x[1500:], y[1500:])])
     assert "eval_loss" in clf.eval_history_[0]
-    # multiclass + eval_set: clear error, not a confusing internal CHECK
+    # multiclass eval_set tracks mlogloss and can early-stop
     rng = np.random.RandomState(7)
-    x3 = rng.randn(600, 3).astype(np.float32)
-    y3 = rng.randint(0, 3, 600)
-    with pytest.raises(Exception, match="multiclass"):
-        GBDTClassifier(num_boost_round=2, max_depth=2, num_bins=8).fit(
-            x3, y3, eval_set=(x3, y3))
+    x3 = rng.randn(2000, 3).astype(np.float32)
+    y3 = (x3[:, 0] > 0).astype(int) + (x3[:, 1] > 0).astype(int)  # 3 classes
+    clf3 = GBDTClassifier(num_boost_round=20, max_depth=3, num_bins=16,
+                          learning_rate=0.5)
+    clf3.fit(x3[:1500], y3[:1500], eval_set=(x3[1500:], y3[1500:]),
+             early_stopping_rounds=5)
+    hist = clf3.eval_history_
+    assert hist[-1]["eval_loss"] < hist[0]["eval_loss"]
+    assert clf3.score(x3[1500:], y3[1500:]) > 0.9
 
 
 def test_unseen_eval_labels_rejected():
