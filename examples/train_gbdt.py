@@ -42,6 +42,13 @@ def main():
     ap.add_argument("--handle-missing", action="store_true",
                     help="sparsity-aware splits: absent/NaN features take "
                          "a reserved bin with learned default directions")
+    ap.add_argument("--eval-data", default="",
+                    help="held-out URI: track per-round eval loss "
+                         "(logloss/mlogloss/MSE per objective)")
+    ap.add_argument("--early-stopping-rounds", type=int, default=0,
+                    help="stop when eval loss hasn't improved for N rounds "
+                         "(needs --eval-data); ensemble truncates to the "
+                         "best round")
     ap.add_argument("--checkpoint", default="")
     args = ap.parse_args()
 
@@ -68,17 +75,21 @@ def main():
     parser = create_parser(args.data, part, nparts, type="auto")
 
     # materialize this shard densely (hist-GBDT trains on the binned matrix)
-    meter = ThroughputMeter("ingest")
-    xs, ys = [], []
     fill = np.nan if args.handle_missing else 0.0
-    for batch in dense_batches(parser, 8192, args.num_feature,
-                               fill_value=fill):
-        n = batch.num_rows
-        xs.append(batch.x[:n])
-        ys.append(batch.label[:n])
-        meter.add(parser.bytes_read(), nrows=n)
-    x = np.concatenate(xs)
-    y = np.concatenate(ys)
+
+    def load_dense(p, meter=None):
+        xs, ys = [], []
+        for batch in dense_batches(p, 8192, args.num_feature,
+                                   fill_value=fill):
+            n = batch.num_rows
+            xs.append(batch.x[:n])
+            ys.append(batch.label[:n])
+            if meter is not None:
+                meter.add(p.bytes_read(), nrows=n)
+        return np.concatenate(xs), np.concatenate(ys)
+
+    meter = ThroughputMeter("ingest")
+    x, y = load_dense(parser, meter)
     print(meter.summary())
 
     param = GBDTParam(num_boost_round=args.rounds, max_depth=args.max_depth,
@@ -98,14 +109,33 @@ def main():
     model.make_bins(x[: min(len(x), 100_000)], comm=comm, count=len(x))
     bins = np.asarray(model.bin_features(x)).astype(np.int32)
 
-    (ensemble, margin), secs = device_timer(
-        lambda b, yy: model.fit_binned(b, yy), bins, y)
+    rounds_run = args.rounds
+    if args.eval_data:
+        ex, ev_y = load_dense(create_parser(args.eval_data, 0, 1,
+                                            type="auto"))
+        ev_bins = np.asarray(model.bin_features(ex)).astype(np.int32)
+        # warmup=0: fit_with_eval is a host-driven round loop, not one jit
+        # whose compile should be amortised — running it twice would double
+        # training time
+        (ensemble, history), secs = device_timer(
+            lambda b, yy: model.fit_with_eval(
+                b, yy, ev_bins, ev_y,
+                early_stopping_rounds=args.early_stopping_rounds),
+            bins, y, warmup=0)
+        rounds_run = len(history)
+        print(f"eval: first {history[0]['eval_loss']:.5f} -> "
+              f"last {history[-1]['eval_loss']:.5f} "
+              f"({ensemble.num_trees} trees kept)")
+        margin = model.predict_margin(ensemble, bins)
+    else:
+        (ensemble, margin), secs = device_timer(
+            lambda b, yy: model.fit_binned(b, yy), bins, y)
     if args.objective == "softmax":
         acc = float((np.asarray(margin).argmax(1) == y).mean())
     else:
         acc = float(((np.asarray(margin) > 0) == y).mean())
-    rows_per_sec = len(y) * args.rounds / secs
-    print(f"trained {args.rounds} rounds on {len(y)} rows in {secs:.2f}s "
+    rows_per_sec = len(y) * rounds_run / secs
+    print(f"trained {rounds_run} rounds on {len(y)} rows in {secs:.2f}s "
           f"({rows_per_sec:,.0f} rows/sec/chip), train acc {acc:.4f}")
     if args.checkpoint:
         save_checkpoint(args.checkpoint, ensemble._asdict())

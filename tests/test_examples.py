@@ -97,3 +97,25 @@ def test_train_mlp_example(tmp_path):
                         "--epochs", "1"])
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "epoch 0: loss=" in proc.stderr + proc.stdout
+
+
+@pytest.mark.slow
+def test_train_gbdt_example_with_eval(tmp_path):
+    rng = np.random.RandomState(9)
+    for name, n in (("tr", 900), ("ev", 300)):
+        lines = []
+        for i in range(n):
+            x = rng.randn(4)
+            y = int(x[0] + x[1] > 0)
+            feats = " ".join(f"{j}:{x[j]:.4f}" for j in range(4))
+            lines.append(f"{y} {feats}")
+        (tmp_path / f"{name}.libsvm").write_text("\n".join(lines) + "\n")
+    proc = run_example(os.path.join(REPO, "examples", "train_gbdt.py"),
+                       ["--data", str(tmp_path / "tr.libsvm"),
+                        "--eval-data", str(tmp_path / "ev.libsvm"),
+                        "--num-feature", "4", "--rounds", "20",
+                        "--max-depth", "3", "--num-bins", "16",
+                        "--early-stopping-rounds", "3"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "eval: first" in proc.stdout
+    assert "trees kept" in proc.stdout
