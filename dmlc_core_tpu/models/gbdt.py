@@ -717,8 +717,14 @@ class GBDT:
         return np.divide(totals, counts, out=np.zeros_like(totals),
                          where=counts > 0)
 
-    def save_model(self, uri: str, ensemble: TreeEnsemble) -> None:
-        """Persist the model + binning boundaries to any URI."""
+    def save_model(self, uri: str, ensemble: TreeEnsemble,
+                   extra: Optional[dict] = None) -> None:
+        """Persist the model + binning boundaries to any URI.
+
+        ``extra`` adds caller-owned numpy leaves to the payload (e.g. the
+        sklearn facade's class labels); keys must not clash with the core
+        schema.
+        """
         from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
 
         CHECK(self.boundaries is not None, "model has no bin boundaries")
@@ -740,13 +746,28 @@ class GBDT:
             payload["split_gain"] = np.asarray(ensemble.split_gain)
         if ensemble.split_cover is not None:
             payload["split_cover"] = np.asarray(ensemble.split_cover)
+        for k, v in (extra or {}).items():
+            CHECK(k not in payload, f"extra key {k!r} clashes with the "
+                                    f"model schema")
+            arr = np.asarray(v)
+            # object arrays serialize as raw pointers and can never load
+            # back (e.g. pandas .to_numpy() labels); reject at save time
+            CHECK(arr.dtype != object,
+                  f"extra key {k!r} has object dtype; convert to a "
+                  f"numeric or fixed-width string array first")
+            payload[k] = arr
         save_checkpoint(uri, payload)
 
     def load_model(self, uri: str) -> TreeEnsemble:
         from dmlc_core_tpu.bridge.checkpoint import load_checkpoint
 
-        flat = load_checkpoint(uri)
+        return self.load_model_dict(load_checkpoint(uri))
 
+    def load_model_dict(self, flat: dict) -> TreeEnsemble:
+        """Restore from an already-loaded checkpoint dict — callers that
+        read extra payload keys themselves (the sklearn facade) avoid a
+        second full fetch of the URI (and the old/new-mix race a re-read
+        of a concurrently replaced remote object would open)."""
         # keys are jax.tree_util.keystr paths; save_model writes a flat dict,
         # so each key is exactly "['<name>']" — match it exactly (a substring
         # match would alias e.g. 'split_feat' with any future key containing

@@ -11,6 +11,7 @@ NaNs in ``X`` switch on sparsity-aware splits unless overridden, and
 
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -130,13 +131,56 @@ class _GBDTEstimator:
         total = imp.sum()
         return imp / total if total > 0 else imp
 
+    def _extra_payload(self) -> Dict[str, Any]:
+        # enough to reconstruct the estimator: the full GBDTParam as JSON
+        # bytes (uint8 leaf) + subclass extras
+        blob = json.dumps(self.model_.param.to_dict()).encode()
+        return {"sk_param": np.frombuffer(blob, np.uint8)}
+
     def save_model(self, uri: str) -> None:
+        """Persist model + boundaries + estimator metadata; reload with
+        ``GBDTClassifier.load_model(uri)`` / ``GBDTRegressor.load_model``."""
         self._check_fitted()
-        self.model_.save_model(uri, self.ensemble_)
+        self.model_.save_model(uri, self.ensemble_,
+                               extra=self._extra_payload())
+
+    @classmethod
+    def load_model(cls, uri: str):
+        """Reconstruct a fitted estimator from :meth:`save_model` output."""
+        from dmlc_core_tpu.bridge.checkpoint import load_checkpoint
+
+        flat = load_checkpoint(uri)
+        key = "['sk_param']"
+        CHECK(key in flat,
+              f"{uri!r} was not written by an estimator's save_model "
+              f"(no sk_param); load it with GBDT.load_model instead")
+        pdict = json.loads(bytes(flat[key]).decode())
+        param = GBDTParam()
+        param.init(pdict)
+        est = cls(handle_missing=param.handle_missing,
+                  **{k: getattr(param, k) for k in _PARAM_KEYS})
+        est._restore(param, flat)
+        boundaries = np.asarray(flat["['boundaries']"], np.float32)
+        model = GBDT(param, num_feature=boundaries.shape[0])
+        est.model_ = model
+        # restore from the dict already in hand: a second full fetch of the
+        # URI would double I/O and could mix metadata/ensemble across a
+        # concurrent replace
+        est.ensemble_ = model.load_model_dict(flat)
+        est.eval_history_ = []
+        return est
+
+    def _restore(self, param: GBDTParam, flat: Dict[str, Any]) -> None:
+        """Subclass hook for estimator-specific payload (class labels)."""
 
 
 class GBDTClassifier(_GBDTEstimator):
     """Binary or multiclass classifier (objective auto-selected from y)."""
+
+    def _extra_payload(self) -> Dict[str, Any]:
+        out = super()._extra_payload()
+        out["sk_classes"] = np.asarray(self.classes_)
+        return out
 
     def _objective_params(self, y: np.ndarray) -> Dict[str, Any]:
         self.classes_ = np.unique(y)
@@ -145,6 +189,13 @@ class GBDTClassifier(_GBDTEstimator):
         if len(self.classes_) == 2:
             return {"objective": "logistic"}
         return {"objective": "softmax", "num_class": len(self.classes_)}
+
+    def _restore(self, param: GBDTParam, flat: Dict[str, Any]) -> None:
+        key = "['sk_classes']"
+        CHECK(key in flat,
+              "checkpoint has no class labels; it was saved by a regressor "
+              "— load it with GBDTRegressor.load_model")
+        self.classes_ = np.asarray(flat[key])
 
     def _encode(self, y: np.ndarray) -> np.ndarray:
         # map original labels to 0..K-1 ids; labels unseen at fit time must
@@ -175,6 +226,11 @@ class GBDTClassifier(_GBDTEstimator):
 
 class GBDTRegressor(_GBDTEstimator):
     """Squared-error regressor."""
+
+    def _restore(self, param: GBDTParam, flat: Dict[str, Any]) -> None:
+        CHECK(param.objective == "squared",
+              f"checkpoint objective is {param.objective!r}; load it with "
+              f"GBDTClassifier.load_model")
 
     def _objective_params(self, y: np.ndarray) -> Dict[str, Any]:
         return {"objective": "squared"}

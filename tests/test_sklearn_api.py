@@ -158,3 +158,70 @@ def test_nan_at_predict_without_missing_support_rejected():
     x_bad[0, 0] = np.nan
     with pytest.raises(Exception, match="handle_missing"):
         clf.predict(x_bad)
+
+
+def test_estimator_save_load_roundtrip(tmp_path):
+    rng = np.random.RandomState(10)
+    x = rng.randn(1500, 4).astype(np.float32)
+    labels = np.array(["a", "b", "c"])
+    y = labels[(x[:, 0] > 0).astype(int) + (x[:, 1] > 0).astype(int)]
+    clf = GBDTClassifier(num_boost_round=5, max_depth=3, num_bins=16,
+                         learning_rate=0.5)
+    clf.fit(x, y)
+    uri = str(tmp_path / "clf.bin")
+    clf.save_model(uri)
+    loaded = GBDTClassifier.load_model(uri)
+    assert list(loaded.classes_) == ["a", "b", "c"]
+    np.testing.assert_array_equal(loaded.predict(x), clf.predict(x))
+    np.testing.assert_allclose(loaded.predict_proba(x),
+                               clf.predict_proba(x), rtol=1e-6)
+    assert loaded.get_params()["max_depth"] == 3
+
+    # regressor roundtrip
+    yr = (x[:, 0] * 2).astype(np.float32)
+    reg = GBDTRegressor(num_boost_round=5, max_depth=3, num_bins=16)
+    reg.fit(x, yr)
+    uri2 = str(tmp_path / "reg.bin")
+    reg.save_model(uri2)
+    loaded_reg = GBDTRegressor.load_model(uri2)
+    np.testing.assert_allclose(loaded_reg.predict(x), reg.predict(x),
+                               rtol=1e-6)
+
+    # cross-type loads refuse clearly
+    with pytest.raises(Exception, match="GBDTClassifier"):
+        GBDTRegressor.load_model(uri)
+    with pytest.raises(Exception, match="regressor"):
+        GBDTClassifier.load_model(uri2)
+    # low-level checkpoints are not estimator checkpoints
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+    low = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=8),
+               num_feature=4)
+    low.make_bins(x)
+    ens, _ = low.fit_binned(low.bin_features(x), (yr > 0).astype(np.float32))
+    uri3 = str(tmp_path / "low.bin")
+    low.save_model(uri3, ens)
+    with pytest.raises(Exception, match="sk_param"):
+        GBDTClassifier.load_model(uri3)
+
+
+def test_nan_missing_mode_survives_save_load(tmp_path):
+    x, y = _binary(n=1200, seed=11)
+    x[::4, 1] = np.nan
+    clf = GBDTClassifier(num_boost_round=4, max_depth=3, num_bins=16)
+    clf.fit(x, y)
+    assert clf.model_.param.handle_missing
+    uri = str(tmp_path / "m.bin")
+    clf.save_model(uri)
+    loaded = GBDTClassifier.load_model(uri)
+    assert loaded.model_.param.handle_missing
+    np.testing.assert_array_equal(loaded.predict(x), clf.predict(x))
+
+
+def test_object_dtype_classes_rejected_at_save(tmp_path):
+    x, y = _binary(n=400, seed=12)
+    y_obj = np.array(["n", "p"], dtype=object)[y]     # pandas-style labels
+    clf = GBDTClassifier(num_boost_round=2, max_depth=2, num_bins=8)
+    clf.fit(x, y_obj)
+    with pytest.raises(Exception, match="object dtype"):
+        clf.save_model(str(tmp_path / "bad.bin"))
