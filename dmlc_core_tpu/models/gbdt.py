@@ -290,7 +290,23 @@ def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int, class_index: int = 0):
     return row_w, fmask
 
 
-def _softmax_round(p, bins, margin, label, weight, rnd, grow):
+def _row_sampling(p, rnd, n_rows: int, B: int, F: int, class_index=0):
+    """Per-tree sampling drawn over the UNPADDED row count, then padded to
+    the working batch: the subsample draw must not depend on kernel row
+    padding, or padded and unpadded entry points (fit_binned vs
+    boost_round) would select different row subsets for the same data.
+    Padding rows carry weight 0 regardless; the pad is shape-only."""
+    import jax.numpy as jnp
+
+    row_w, fmask = _tree_sampling(p, rnd, n_rows, F,
+                                  class_index=class_index)
+    if row_w is not None and B != n_rows:
+        row_w = jnp.pad(row_w, (0, B - n_rows))
+    return row_w, fmask
+
+
+def _softmax_round(p, bins, margin, label, weight, rnd, grow,
+                   n_rows=None):
     """One multiclass boosting round: K trees from one margin snapshot
     (XGBoost multi:softmax — gradients evaluated before any of the round's
     K updates land), each tree drawing its own row/feature subset.
@@ -298,11 +314,13 @@ def _softmax_round(p, bins, margin, label, weight, rnd, grow):
     import jax.numpy as jnp
 
     K = p.num_class
+    B = bins.shape[0]
+    n_rows = B if n_rows is None else n_rows
     g_all, h_all = _softmax_grad_hess(margin, label, K)
     trees = []
     for k in range(K):
-        row_w, fmask = _tree_sampling(p, rnd, bins.shape[0], bins.shape[1],
-                                      class_index=k)
+        row_w, fmask = _row_sampling(p, rnd, n_rows, B, bins.shape[1],
+                                     class_index=k)
         w = weight if row_w is None else weight * row_w
         trees.append(grow(bins, g_all[:, k] * w, h_all[:, k] * w, rnd,
                           fmask))
@@ -453,12 +471,28 @@ class GBDT:
 
     @functools.lru_cache(maxsize=None)
     def _fit_fn(self, num_rounds: int, method: str = "scatter"):
+        return self._build_fit(num_rounds, method, with_eval=False)
+
+    @functools.lru_cache(maxsize=None)
+    def _fit_eval_fn(self, num_rounds: int, method: str = "scatter"):
+        """:meth:`_fit_fn` + per-round eval-margin accumulation and
+        train/eval losses — the whole eval-tracked fit is ONE compiled
+        program (the round-by-round host loop costs ~a round-trip per
+        round; early stopping becomes a host post-pass over the losses)."""
+        return self._build_fit(num_rounds, method, with_eval=True)
+
+    def _build_fit(self, num_rounds: int, method: str, with_eval: bool):
+        """One jitted scan-fit builder serving both entry points — the
+        training body (padding, sampling, grow) must never fork between
+        the plain and eval-tracked fits."""
         import jax
         import jax.lax as lax
 
         p = self.param
+        d = p.max_depth
+        miss_id = p.num_bins - 1 if p.handle_missing else -1
 
-        def fit(bins, label, weight):
+        def fit(bins, label, weight, ev_bins=None, ev_label=None):
             import jax.numpy as jnp
 
             n_rows = bins.shape[0]
@@ -478,7 +512,6 @@ class GBDT:
             # levels: materialise once, outside the scan
             onehot = (bin_onehot(bins, p.num_bins)
                       if method == "onehot" else None)
-
             K = p.num_class if p.objective == "softmax" else 1
 
             def grow(bins_, g, h, rnd, fmask):
@@ -489,23 +522,50 @@ class GBDT:
                     min_split_loss=p.min_split_loss, feat_mask=fmask,
                     missing=p.handle_missing)
 
-            def body(margin, rnd):
+            def round_step(margin, rnd):
                 if K == 1:
-                    row_w, fmask = _tree_sampling(p, rnd, B, bins.shape[1])
+                    row_w, fmask = _row_sampling(p, rnd, n_rows, B,
+                                                 bins.shape[1])
                     w = weight if row_w is None else weight * row_w
                     g, h = _grad_hess(margin, label, p.objective)
                     sf, sb, lv, dl, sg, sc, delta = grow(bins, g * w,
                                                          h * w, rnd, fmask)
                     return margin + delta, (sf, sb, lv, dl, sg, sc)
                 return _softmax_round(p, bins, margin, label, weight, rnd,
-                                      grow)
+                                      grow, n_rows=n_rows)
 
-            margin0 = jnp.zeros((B,) if K == 1 else (B, K),
-                                dtype=jnp.float32)
-            margin, (sfs, sbs, lvs, dls, sgs, scs) = lax.scan(
-                body, margin0, jnp.arange(num_rounds, dtype=jnp.uint32))
-            return (TreeEnsemble(sfs, sbs, lvs, dls, sgs, scs),
-                    margin[:n_rows])
+            margin0 = jnp.zeros((B,) if K == 1 else (B, K), jnp.float32)
+            rounds = jnp.arange(num_rounds, dtype=jnp.uint32)
+
+            if not with_eval:
+                margin, trees = lax.scan(round_step, margin0, rounds)
+                return TreeEnsemble(*trees), margin[:n_rows]
+
+            def eval_body(carry, rnd):
+                margin, ev_margin = carry
+                margin, trees = round_step(margin, rnd)
+                sf, sb, lv, dl = trees[:4]
+                if K == 1:
+                    ev_delta = _predict_tree(sf, sb, lv, dl, ev_bins, d,
+                                             miss_id)
+                else:
+                    ev_delta = jnp.stack(
+                        [_predict_tree(sf[k], sb[k], lv[k], dl[k], ev_bins,
+                                       d, miss_id) for k in range(K)],
+                        axis=1)
+                ev_margin = ev_margin + ev_delta
+                # losses on the REAL rows (padded rows carry weight 0 but
+                # _logloss is unweighted)
+                tr_loss = _logloss(margin[:n_rows], label[:n_rows],
+                                   p.objective)
+                ev_loss = _logloss(ev_margin, ev_label, p.objective)
+                return (margin, ev_margin), (trees, tr_loss, ev_loss)
+
+            ev0 = jnp.zeros((ev_bins.shape[0],) if K == 1
+                            else (ev_bins.shape[0], K), jnp.float32)
+            (margin, _), (trees, trl, evl) = lax.scan(
+                eval_body, (margin0, ev0), rounds)
+            return TreeEnsemble(*trees), margin[:n_rows], trl, evl
 
         return jax.jit(fit)
 
@@ -625,13 +685,23 @@ class GBDT:
         return jax.jit(one_tree)
 
     def fit_with_eval(self, bins, label, eval_bins=None, eval_label=None,
-                      weight=None, early_stopping_rounds: int = 0):
-        """Round-by-round boosting with validation logloss tracking.
+                      weight=None, early_stopping_rounds: int = 0,
+                      compiled: bool = True):
+        """Boosting with validation loss tracking and early stopping.
 
         Returns (ensemble, history) where history is a list of per-round dicts
         (train margin loss and, when an eval set is given, eval loss).  With
         ``early_stopping_rounds`` > 0, stops when eval loss hasn't improved
         for that many rounds and truncates the ensemble to the best round.
+
+        ``compiled=True`` (default, needs an eval set) runs the WHOLE
+        eval-tracked fit as one jit — per-round losses come back as arrays
+        and the sequential stopping rule is applied on the host afterwards,
+        giving bit-identical results to the round-by-round loop at scan-fit
+        speed (rounds past the stopping point are computed then discarded:
+        on accelerators the flops are cheaper than per-round host syncs).
+        ``compiled=False`` keeps the host-driven loop (debugging, or when
+        per-round side effects are wanted).
         """
         import jax.numpy as jnp
 
@@ -645,6 +715,11 @@ class GBDT:
                   if weight is None else jnp.asarray(weight))
         bins = jnp.asarray(bins)
         label = jnp.asarray(label, jnp.float32)
+        if compiled and eval_bins is not None:
+            return self._fit_with_eval_compiled(
+                bins, label, jnp.asarray(eval_bins),
+                jnp.asarray(eval_label, jnp.float32), weight,
+                early_stopping_rounds)
         mshape = (bins.shape[0],) if K == 1 else (bins.shape[0], K)
         margin = jnp.zeros(mshape, jnp.float32)
         eval_margin = None
@@ -656,7 +731,7 @@ class GBDT:
             eval_margin = jnp.zeros(eshape, jnp.float32)
         trees = []
         history = []
-        best_round, best_loss = -1, float("inf")
+        stopper = _EarlyStop(early_stopping_rounds)
         tree_margin = self._tree_margin_fn()
         for r in range(self.param.num_boost_round):
             margin, (sf, sb, lv, dl, sg, sc) = self.boost_round(
@@ -677,16 +752,42 @@ class GBDT:
                 eval_loss = float(_logloss(eval_margin, eval_label,
                                            self.param.objective))
                 entry["eval_loss"] = eval_loss
-                if eval_loss < best_loss - 1e-9:
-                    best_loss, best_round = eval_loss, r
-                elif (early_stopping_rounds
-                      and r - best_round >= early_stopping_rounds):
-                    trees = trees[:best_round + 1]
+                if stopper.update(r, eval_loss):
+                    trees = trees[:stopper.best_round + 1]
                     history.append(entry)
                     break
             history.append(entry)
         stacked = [jnp.stack([t[i] for t in trees]) for i in range(6)]
         return TreeEnsemble(*stacked), history
+
+    def _fit_with_eval_compiled(self, bins, label, eval_bins, eval_label,
+                                weight, early_stopping_rounds: int):
+        """One-jit eval-tracked fit + host-side sequential stopping rule
+        (see :meth:`fit_with_eval`); returns identical (ensemble, history)
+        to the round-by-round loop."""
+        from dmlc_core_tpu.ops.hist_pallas import BLOCK_ROWS
+
+        R = self.param.num_boost_round
+        padded = -(-bins.shape[0] // BLOCK_ROWS) * BLOCK_ROWS
+        method = self._method(bins, batch=padded)
+        ens, _, trl, evl = self._fit_eval_fn(R, method)(
+            bins, label, weight, eval_bins, eval_label)
+        trl = np.asarray(trl)
+        evl = np.asarray(evl)
+        history = []
+        stopper = _EarlyStop(early_stopping_rounds)
+        stop_after = R
+        for r in range(R):
+            history.append({"round": r, "train_loss": float(trl[r]),
+                            "eval_loss": float(evl[r])})
+            if stopper.update(r, float(evl[r])):
+                stop_after = stopper.best_round + 1
+                break
+        if stop_after < R:
+            ens = TreeEnsemble(*(None if a is None
+                                 else np.asarray(a)[:stop_after]
+                                 for a in ens))
+        return ens, history
 
     # -- introspection / persistence ------------------------------------------
     def feature_importance(self, ensemble: TreeEnsemble,
@@ -802,6 +903,25 @@ class GBDT:
         return TreeEnsemble(sf, get("split_bin"), get("leaf_value"), dl,
                             None if sg is None else np.asarray(sg),
                             None if sc is None else np.asarray(sc))
+
+
+class _EarlyStop:
+    """The sequential stopping rule shared by the host loop and the
+    compiled post-pass: improvement = loss drop > 1e-9; stop once
+    ``patience`` rounds pass without one.  One implementation — the
+    compiled path's bit-identical-history guarantee depends on it."""
+
+    def __init__(self, patience: int):
+        self.patience = patience
+        self.best_round = -1
+        self.best_loss = float("inf")
+
+    def update(self, r: int, loss: float) -> bool:
+        """Record round r's eval loss; True = stop after this round."""
+        if loss < self.best_loss - 1e-9:
+            self.best_loss, self.best_round = loss, r
+            return False
+        return bool(self.patience) and r - self.best_round >= self.patience
 
 
 def _logloss(margin, label, objective: str):

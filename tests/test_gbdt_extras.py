@@ -383,3 +383,39 @@ def test_softmax_eval_label_range_checked():
     good = np.zeros(10, np.float32)
     with pytest.raises(Exception, match="eval labels"):
         m.fit_with_eval(bins, good, bins, np.full(10, 4.0, np.float32))
+
+
+def test_compiled_eval_fit_matches_host_loop():
+    """compiled=True (one jit) must reproduce the round-by-round loop
+    exactly: same trees, same truncation, same losses — binary and
+    softmax, with and without early stopping firing."""
+    rng = np.random.RandomState(13)
+    n = 1200
+    x = rng.randn(n, 4).astype(np.float32)
+    y_bin = (x[:, 0] + x[:, 1] > 0).astype(np.float32)
+    y_mc = ((x[:, 0] > 0).astype(int)
+            + (x[:, 1] > 0).astype(int)).astype(np.float32)
+    for objective, y, K in (("logistic", y_bin, 1), ("softmax", y_mc, 3)):
+        m = GBDT(GBDTParam(num_boost_round=12, max_depth=3, num_bins=16,
+                           learning_rate=0.9, objective=objective,
+                           num_class=K), num_feature=4)
+        m.make_bins(x)
+        bins = np.asarray(m.bin_features(x), np.int32)
+        tr, ev = bins[:900], bins[900:]
+        ytr, yev = y[:900], y[900:]
+        for esr in (0, 2):
+            ens_c, hist_c = m.fit_with_eval(tr, ytr, ev, yev,
+                                            early_stopping_rounds=esr,
+                                            compiled=True)
+            ens_h, hist_h = m.fit_with_eval(tr, ytr, ev, yev,
+                                            early_stopping_rounds=esr,
+                                            compiled=False)
+            assert len(hist_c) == len(hist_h), (objective, esr)
+            for a, b in zip(hist_c, hist_h):
+                assert abs(a["train_loss"] - b["train_loss"]) < 1e-5
+                assert abs(a["eval_loss"] - b["eval_loss"]) < 1e-5
+            np.testing.assert_array_equal(np.asarray(ens_c.split_feat),
+                                          np.asarray(ens_h.split_feat))
+            np.testing.assert_allclose(np.asarray(ens_c.leaf_value),
+                                       np.asarray(ens_h.leaf_value),
+                                       rtol=1e-5, atol=1e-6)

@@ -371,3 +371,34 @@ def test_i8_disable_env(monkeypatch):
         assert not hist_pallas.pallas_i8_supported()
     finally:
         hist_pallas.pallas_i8_supported.cache_clear()
+
+
+def test_subsample_draw_independent_of_row_padding(interpret_mode):
+    """The per-tree subsample draw must be made over the UNPADDED row count:
+    fit_binned pads rows to the pallas tile, boost_round does not — with
+    padding-dependent sampling the two entry points would train different
+    trees on identical data (n_rows deliberately not a tile multiple)."""
+    import jax.numpy as jnp
+
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+    rng = np.random.RandomState(21)
+    n, F = 1500, 4                       # 1500 % 1024 != 0 -> fit pads
+    x = rng.randn(n, F).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    m = GBDT(GBDTParam(num_boost_round=3, max_depth=3, num_bins=16,
+                       subsample=0.7, seed=5, hist_method="pallas"),
+             num_feature=F)
+    m.make_bins(x)
+    bins = jnp.asarray(np.asarray(m.bin_features(x), np.int32))
+    ens_fit, _ = m.fit_binned(bins, y)
+
+    margin = jnp.zeros(n, jnp.float32)
+    w = jnp.ones(n, jnp.float32)
+    sfs = []
+    for r in range(3):
+        margin, tree = m.boost_round(margin, bins, jnp.asarray(y), w,
+                                     round_index=r)
+        sfs.append(np.asarray(tree[0]))
+    np.testing.assert_array_equal(np.stack(sfs),
+                                  np.asarray(ens_fit.split_feat))
