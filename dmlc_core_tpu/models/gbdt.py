@@ -789,6 +789,51 @@ class GBDT:
                                  for a in ens))
         return ens, history
 
+    @functools.lru_cache(maxsize=None)
+    def _staged_losses_fn(self):
+        import jax
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        p = self.param
+        d = p.max_depth
+        miss_id = p.num_bins - 1 if p.handle_missing else -1
+        K = p.num_class if p.objective == "softmax" else 1
+
+        def staged(ensemble, bins, label):
+            B = bins.shape[0]
+
+            def body(margin, tree):
+                sf, sb, lv, dl = tree
+                if K == 1:
+                    delta = _predict_tree(sf, sb, lv, dl, bins, d, miss_id)
+                else:
+                    delta = jnp.stack(
+                        [_predict_tree(sf[k], sb[k], lv[k], dl[k], bins, d,
+                                       miss_id) for k in range(K)], axis=1)
+                margin = margin + delta
+                return margin, _logloss(margin, label, p.objective)
+
+            margin0 = jnp.zeros((B,) if K == 1 else (B, K), jnp.float32)
+            _, losses = lax.scan(body, margin0,
+                                 (ensemble.split_feat, ensemble.split_bin,
+                                  ensemble.leaf_value,
+                                  ensemble.default_left))
+            return losses
+
+        return jax.jit(staged)
+
+    def staged_losses(self, ensemble: TreeEnsemble, bins, label) -> np.ndarray:
+        """Per-round cumulative loss of the ensemble on any dataset — the
+        learning curve, post-hoc, as one compiled scan over the tree axis
+        (logloss / mlogloss / MSE per the objective).  [num_trees] f32."""
+        import jax.numpy as jnp
+
+        if self.param.objective == "softmax":
+            _check_softmax_labels(label, self.param.num_class)
+        return np.asarray(self._staged_losses_fn()(
+            ensemble, jnp.asarray(bins), jnp.asarray(label, jnp.float32)))
+
     # -- introspection / persistence ------------------------------------------
     def feature_importance(self, ensemble: TreeEnsemble,
                            kind: str = "weight") -> np.ndarray:

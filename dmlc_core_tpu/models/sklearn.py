@@ -87,19 +87,37 @@ class _GBDTEstimator:
         bins = self.model_.bin_features(X)
         yy = self._encode(y)
         if eval_set is not None:
-            # accept the XGBoost sklearn spelling eval_set=[(X, y)] too
-            if (isinstance(eval_set, (list, tuple)) and len(eval_set) == 1
-                    and isinstance(eval_set[0], (list, tuple))):
-                eval_set = eval_set[0]
-            CHECK(len(eval_set) == 2,
-                  "eval_set must be (X_val, y_val) or [(X_val, y_val)]; "
-                  "multiple eval sets are not supported")
-            Xv, yv = eval_set
-            ev_bins = self.model_.bin_features(np.asarray(Xv, np.float32))
+            # accept bare (X, y) or the XGBoost spelling [(X0, y0), ...];
+            # like XGBoost, the LAST set drives early stopping.  A bare
+            # pair is recognised by its first element being a 2-D feature
+            # matrix (list-of-rows X included); anything else is treated
+            # as a list of pairs.
+            def _is_pair(es):
+                if not isinstance(es, (list, tuple)) or len(es) != 2:
+                    return False
+                try:
+                    return np.asarray(es[0], np.float32).ndim == 2
+                except Exception:
+                    return False
+
+            sets = [eval_set] if _is_pair(eval_set) else list(eval_set)
+            CHECK(sets and all(_is_pair(sv) for sv in sets),
+                  "eval_set must be (X_val, y_val) or a list of such pairs")
+            binned = [(self.model_.bin_features(np.asarray(Xv, np.float32)),
+                       self._encode(np.asarray(yv))) for Xv, yv in sets]
+            ev_bins, ev_y = binned[-1]
             self.ensemble_, self.eval_history_ = self.model_.fit_with_eval(
-                bins, yy, ev_bins, self._encode(np.asarray(yv)),
-                weight=sample_weight,
+                bins, yy, ev_bins, ev_y, weight=sample_weight,
                 early_stopping_rounds=early_stopping_rounds)
+            # per-round curves for the remaining sets, post-hoc (one
+            # compiled scan each).  NOTE: computed from the FINAL (possibly
+            # early-stop-truncated) ensemble, so history entries past the
+            # kept rounds carry only the primary set's eval_loss
+            for i, (bv, lv) in enumerate(binned[:-1]):
+                curve = self.model_.staged_losses(self.ensemble_, bv, lv)
+                for r, entry in enumerate(self.eval_history_):
+                    if r < len(curve):
+                        entry[f"eval{i}_loss"] = float(curve[r])
         else:
             self.ensemble_, _ = self.model_.fit_binned(bins, yy,
                                                        weight=sample_weight)

@@ -419,3 +419,12 @@ def test_compiled_eval_fit_matches_host_loop():
             np.testing.assert_allclose(np.asarray(ens_c.leaf_value),
                                        np.asarray(ens_h.leaf_value),
                                        rtol=1e-5, atol=1e-6)
+
+
+def test_staged_losses_matches_eval_history(model_and_data):
+    model, bins, y, bins_v, yv = model_and_data
+    ens, hist = model.fit_with_eval(bins, y, bins_v, yv)
+    curve = model.staged_losses(ens, bins_v, yv)
+    assert curve.shape == (ens.num_trees,)
+    for r, entry in enumerate(hist):
+        assert abs(float(curve[r]) - entry["eval_loss"]) < 1e-5

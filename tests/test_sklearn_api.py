@@ -225,3 +225,24 @@ def test_object_dtype_classes_rejected_at_save(tmp_path):
     clf.fit(x, y_obj)
     with pytest.raises(Exception, match="object dtype"):
         clf.save_model(str(tmp_path / "bad.bin"))
+
+
+def test_multiple_eval_sets():
+    x, y = _binary(n=3000, seed=14)
+    clf = GBDTClassifier(num_boost_round=6, max_depth=3, num_bins=16,
+                         learning_rate=0.5)
+    clf.fit(x[:2000], y[:2000],
+            eval_set=[(x[2000:2500], y[2000:2500]),
+                      (x[2500:], y[2500:])],
+            early_stopping_rounds=3)
+    hist = clf.eval_history_
+    assert "eval_loss" in hist[0]        # the LAST set (drives stopping)
+    assert "eval0_loss" in hist[0]       # the first set's curve
+    kept = clf.ensemble_.num_trees       # entries past truncation carry
+    last = hist[kept - 1]                # only the primary eval_loss
+    assert last["eval0_loss"] < hist[0]["eval0_loss"]
+    # list-of-rows X in a bare pair must not be misread as a pair list
+    clf2 = GBDTClassifier(num_boost_round=3, max_depth=2, num_bins=8)
+    clf2.fit(x[:500], y[:500],
+             eval_set=(x[500:700].tolist(), y[500:700].tolist()))
+    assert "eval_loss" in clf2.eval_history_[0]
