@@ -863,6 +863,78 @@ class GBDT:
         return np.divide(totals, counts, out=np.zeros_like(totals),
                          where=counts > 0)
 
+    def dump_trees(self, ensemble: TreeEnsemble,
+                   feature_names=None) -> str:
+        """Human-readable text dump of every tree (XGBoost get_dump
+        style): internal nodes show the split feature, the REAL threshold
+        value (bin id mapped back through the binning boundaries; routing
+        is strict — rows with value < threshold go left, ties go right,
+        matching apply_bins' side='right' searchsorted), the
+        missing-row default direction, and the recorded gain/cover; leaves
+        show their values.  No-split nodes collapse into their left
+        subtree, matching the routing semantics."""
+        CHECK(self.boundaries is not None,
+              "dump_trees needs the binning boundaries; call make_bins or "
+              "load_model first")
+        sf_all = np.asarray(ensemble.split_feat)
+        sb_all = np.asarray(ensemble.split_bin)
+        lv_all = np.asarray(ensemble.leaf_value)
+        dl_all = np.asarray(ensemble.default_left)
+        sg_all = (None if ensemble.split_gain is None
+                  else np.asarray(ensemble.split_gain))
+        sc_all = (None if ensemble.split_cover is None
+                  else np.asarray(ensemble.split_cover))
+        multiclass = sf_all.ndim == 3
+        lines = []
+
+        def one_tree(sf, sb, lv, dl, sg, sc, title):
+            lines.append(f"booster[{title}]:")
+            d = self.param.max_depth
+
+            def walk(node, depth, indent):
+                if depth < d:
+                    i = 2 ** depth - 1 + node    # flat level-order id
+                    if sf[i] >= 0:
+                        f = int(sf[i])
+                        b = int(sb[i])
+                        bounds = self.boundaries[f]
+                        thr = (float(bounds[b]) if b < len(bounds)
+                               else float("inf"))
+                        name = (feature_names[f]
+                                if feature_names is not None else f"f{f}")
+                        miss = "yes" if (dl is not None and dl[i]) else "no"
+                        extra = ""
+                        if sg is not None:
+                            extra = (f",gain={sg[i]:.6g}"
+                                     f",cover={sc[i]:.6g}")
+                        lines.append(f"{indent}{i}:[{name}<{thr:.6g}] "
+                                     f"missing_left={miss}{extra}")
+                        walk(node * 2, depth + 1, indent + "  ")
+                        walk(node * 2 + 1, depth + 1, indent + "  ")
+                        return
+                # leaf or collapsed no-split subtree: rows fall through
+                # left to the leaf slot
+                leaf = node
+                for _ in range(depth, d):
+                    leaf = leaf * 2
+                lines.append(f"{indent}leaf={lv[leaf]:.6g}")
+
+            walk(0, 0, "  ")
+
+        for t in range(ensemble.num_trees):
+            if multiclass:
+                for k in range(sf_all.shape[1]):
+                    one_tree(sf_all[t, k], sb_all[t, k], lv_all[t, k],
+                             dl_all[t, k],
+                             None if sg_all is None else sg_all[t, k],
+                             None if sc_all is None else sc_all[t, k],
+                             f"{t}.class{k}")
+            else:
+                one_tree(sf_all[t], sb_all[t], lv_all[t], dl_all[t],
+                         None if sg_all is None else sg_all[t],
+                         None if sc_all is None else sc_all[t], str(t))
+        return "\n".join(lines) + "\n"
+
     def save_model(self, uri: str, ensemble: TreeEnsemble,
                    extra: Optional[dict] = None) -> None:
         """Persist the model + binning boundaries to any URI.

@@ -428,3 +428,39 @@ def test_staged_losses_matches_eval_history(model_and_data):
     assert curve.shape == (ens.num_trees,)
     for r, entry in enumerate(hist):
         assert abs(float(curve[r]) - entry["eval_loss"]) < 1e-5
+
+
+def test_dump_trees(model_and_data, tmp_path):
+    model, bins, y, _, _ = model_and_data
+    ens, _ = model.fit_binned(bins, y)
+    dump = model.dump_trees(ens)
+    assert dump.count("booster[") == ens.num_trees
+    assert "leaf=" in dump and "gain=" in dump and "missing_left=" in dump
+    # thresholds are REAL feature values from the boundaries, and named
+    # features render
+    named = model.dump_trees(ens, feature_names=[f"col{i}" for i in
+                                                 range(model.num_feature)])
+    assert "col" in named
+    # root split threshold of tree 0 maps through the boundaries
+    import re
+    m = re.search(r"0:\[f(\d+)<([-\d.e+]+)\]", dump)
+    assert m, dump.splitlines()[:3]
+    f, thr = int(m.group(1)), float(m.group(2))
+    sb0 = int(np.asarray(ens.split_bin)[0][0])
+    assert abs(thr - float(model.boundaries[f][sb0])) < 1e-4
+
+
+def test_dump_trees_multiclass_and_missing():
+    rng = np.random.RandomState(15)
+    x = rng.randn(800, 3).astype(np.float32)
+    x[::6, 0] = np.nan
+    y = ((np.nan_to_num(x[:, 0]) > 0).astype(int)
+         + (x[:, 1] > 0).astype(int)).astype(np.float32)
+    m = GBDT(GBDTParam(num_boost_round=2, max_depth=3, num_bins=16,
+                       objective="softmax", num_class=3,
+                       handle_missing=True), num_feature=3)
+    m.make_bins(x)
+    ens, _ = m.fit_binned(m.bin_features(x), y)
+    dump = m.dump_trees(ens)
+    assert "class0" in dump and "class2" in dump
+    assert dump.count("booster[") == 2 * 3
