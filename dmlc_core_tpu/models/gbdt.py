@@ -46,6 +46,12 @@ class GBDTParam(Parameter):
                      help="feature histogram bins")
     learning_rate = field(float, default=0.3, lower=0.0, help="shrinkage eta")
     reg_lambda = field(float, default=1.0, lower=0.0, help="L2 on leaf weights")
+    reg_alpha = field(float, default=0.0, lower=0.0,
+                      help="L1 on leaf weights (XGBoost alpha: gradient "
+                           "sums are soft-thresholded in gains and leaves)")
+    scale_pos_weight = field(float, default=1.0, lower=0.0,
+                             help="weight multiplier for positive rows "
+                                  "(logistic class-imbalance knob)")
     min_child_weight = field(float, default=1.0, lower=0.0,
                              help="minimum hessian sum per child")
     min_split_loss = field(float, default=0.0, lower=0.0,
@@ -105,6 +111,17 @@ def _grad_hess(margin, label, objective: str):
     return margin - label, jnp.ones_like(margin)
 
 
+def _apply_pos_weight(weight, label, p):
+    """scale_pos_weight: positive-class rows count spw-times harder in
+    every gradient/hessian sum (XGBoost's imbalance knob; logistic only —
+    other objectives have no positive class)."""
+    if p.scale_pos_weight == 1.0 or p.objective != "logistic":
+        return weight
+    import jax.numpy as jnp
+
+    return weight * jnp.where(label > 0.5, p.scale_pos_weight, 1.0)
+
+
 def _softmax_grad_hess(margin, label, num_class: int):
     """Per-class gradients for softmax cross-entropy: margin [B, K],
     integer labels [B] -> (g, h) each [B, K].
@@ -123,6 +140,17 @@ def _softmax_grad_hess(margin, label, num_class: int):
     return pr - onehot, jnp.maximum(2.0 * pr * (1.0 - pr), 1e-16)
 
 
+def _l1_threshold(G, alpha: float):
+    """XGBoost's ThresholdL1: soft-threshold the gradient sum so both the
+    split gain and the leaf value see |G| shrunk by alpha (CalcWeight /
+    CalcGainGivenWeight semantics).  alpha=0 is the identity."""
+    if alpha == 0.0:
+        return G
+    import jax.numpy as jnp
+
+    return jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)
+
+
 def _check_softmax_labels(label, num_class: int, what: str = "labels"):
     """Host-side class-id range check shared by every softmax entry point:
     out-of-range ids silently clamp under jit (take_along_axis / one-hot),
@@ -138,7 +166,7 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 min_child_weight: float, learning_rate: float,
                 model_axis: Optional[str] = None, method: str = "scatter",
                 onehot=None, min_split_loss: float = 0.0, feat_mask=None,
-                missing: bool = False):
+                missing: bool = False, reg_alpha: float = 0.0):
     """Grow one tree level-by-level; returns (split_feat, split_bin,
     leaf_value, default_left, split_gain, split_cover, margin_delta).
     Pure jax, shapes static in (max_depth, num_bins, F).
@@ -178,11 +206,15 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         HT = HL[..., -1:]
         lam = reg_lambda
 
+        GTa = _l1_threshold(GT, reg_alpha)
+
         def _gain(GLv, HLv):
             GRv = GT - GLv
             HRv = HT - HLv
-            gn = (GLv ** 2 / (HLv + lam) + GRv ** 2 / (HRv + lam)
-                  - GT ** 2 / (HT + lam))                # [n, F, nbins]
+            GLa = _l1_threshold(GLv, reg_alpha)
+            GRa = _l1_threshold(GRv, reg_alpha)
+            gn = (GLa ** 2 / (HLv + lam) + GRa ** 2 / (HRv + lam)
+                  - GTa ** 2 / (HT + lam))               # [n, F, nbins]
             ok = (HLv >= min_child_weight) & (HRv >= min_child_weight)
             return gn, ok
 
@@ -256,7 +288,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     else:
         Gl = jax.ops.segment_sum(g, node, num_segments=n_leaf)
         Hl = jax.ops.segment_sum(h, node, num_segments=n_leaf)
-    leaf_value = (-Gl / (Hl + reg_lambda)) * learning_rate
+    leaf_value = (-_l1_threshold(Gl, reg_alpha)
+                  / (Hl + reg_lambda)) * learning_rate
     margin_delta = leaf_value[node]
     return (split_feat, split_bin, leaf_value, default_left, split_gain,
             split_cover, margin_delta)
@@ -364,6 +397,10 @@ class GBDT:
                  model_axis: Optional[str] = None):
         CHECK(param.objective != "softmax" or param.num_class >= 2,
               "objective=softmax needs num_class >= 2")
+        CHECK(param.scale_pos_weight == 1.0 or param.objective == "logistic",
+              f"scale_pos_weight={param.scale_pos_weight} only applies to "
+              f"objective=logistic (got {param.objective!r}); it would "
+              f"silently do nothing here")
         self.param = param
         self.num_feature = num_feature
         self.model_axis = model_axis
@@ -453,7 +490,7 @@ class GBDT:
                     p.min_child_weight, p.learning_rate, self.model_axis,
                     method=method, onehot=onehot,
                     min_split_loss=p.min_split_loss, feat_mask=fmask,
-                    missing=p.handle_missing)
+                    missing=p.handle_missing, reg_alpha=p.reg_alpha)
 
             if p.objective == "softmax":
                 return _softmax_round(p, bins, margin, label, weight, rnd,
@@ -508,6 +545,7 @@ class GBDT:
                     label = jnp.pad(label, (0, pad))
                     weight = jnp.pad(weight, (0, pad))
             B = bins.shape[0]
+            weight = _apply_pos_weight(weight, label, p)
             # the bin one-hot (the matmul RHS) is invariant across rounds and
             # levels: materialise once, outside the scan
             onehot = (bin_onehot(bins, p.num_bins)
@@ -520,7 +558,7 @@ class GBDT:
                     p.min_child_weight, p.learning_rate, self.model_axis,
                     method=method, onehot=onehot,
                     min_split_loss=p.min_split_loss, feat_mask=fmask,
-                    missing=p.handle_missing)
+                    missing=p.handle_missing, reg_alpha=p.reg_alpha)
 
             def round_step(margin, rnd):
                 if K == 1:
@@ -639,6 +677,8 @@ class GBDT:
                   "colsample_bytree are enabled (each tree must draw a "
                   "fresh subset)")
             round_index = 0
+        weight = _apply_pos_weight(jnp.asarray(weight),
+                                   jnp.asarray(label), self.param)
         return self._round_fn(self._method(bins, margin,
                                            batch=bins.shape[0]))(
             margin, bins, label, weight,

@@ -23,8 +23,9 @@ __all__ = ["GBDTClassifier", "GBDTRegressor"]
 
 # GBDTParam fields settable through the estimator constructor
 _PARAM_KEYS = ("num_boost_round", "max_depth", "num_bins", "learning_rate",
-               "reg_lambda", "min_child_weight", "min_split_loss",
-               "subsample", "colsample_bytree", "seed", "hist_method")
+               "reg_lambda", "reg_alpha", "min_child_weight",
+               "min_split_loss", "subsample", "colsample_bytree",
+               "scale_pos_weight", "seed", "hist_method")
 
 
 class _GBDTEstimator:

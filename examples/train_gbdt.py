@@ -36,6 +36,10 @@ def main():
                     help="classes for --objective softmax")
     ap.add_argument("--min-split-loss", type=float, default=0.0,
                     help="gamma: minimum gain to split")
+    ap.add_argument("--reg-alpha", type=float, default=0.0,
+                    help="L1 on leaf weights")
+    ap.add_argument("--scale-pos-weight", type=float, default=1.0,
+                    help="positive-class weight multiplier (logistic)")
     ap.add_argument("--subsample", type=float, default=1.0)
     ap.add_argument("--colsample-bytree", type=float, default=1.0)
     ap.add_argument("--seed", type=int, default=0)
@@ -96,6 +100,8 @@ def main():
                       num_bins=args.num_bins, learning_rate=args.learning_rate,
                       hist_method=args.hist_method,
                       min_split_loss=args.min_split_loss,
+                      reg_alpha=args.reg_alpha,
+                      scale_pos_weight=args.scale_pos_weight,
                       subsample=args.subsample,
                       colsample_bytree=args.colsample_bytree, seed=args.seed,
                       objective=args.objective, num_class=args.num_class,

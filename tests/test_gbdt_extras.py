@@ -464,3 +464,78 @@ def test_dump_trees_multiclass_and_missing():
     dump = m.dump_trees(ens)
     assert "class0" in dump and "class2" in dump
     assert dump.count("booster[") == 2 * 3
+
+
+def test_reg_alpha_l1():
+    rng = np.random.RandomState(16)
+    x = rng.randn(2000, 4).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+
+    def fit(alpha):
+        m = GBDT(GBDTParam(num_boost_round=3, max_depth=3, num_bins=16,
+                           reg_alpha=alpha, learning_rate=0.5),
+                 num_feature=4)
+        m.make_bins(x)
+        return m, m.fit_binned(m.bin_features(x), y)
+
+    m0, (ens0, mar0) = fit(0.0)
+    m1, (ens1, mar1) = fit(5.0)
+    # L1 shrinks leaf magnitudes
+    assert (np.abs(np.asarray(ens1.leaf_value)).max()
+            < np.abs(np.asarray(ens0.leaf_value)).max())
+    # absurd alpha kills every split and zeroes the model
+    m9, (ens9, mar9) = fit(1e9)
+    assert not (np.asarray(ens9.split_feat) >= 0).any()
+    np.testing.assert_allclose(np.asarray(ens9.leaf_value), 0.0)
+
+
+def test_scale_pos_weight_shifts_decision_rate():
+    rng = np.random.RandomState(17)
+    n = 4000
+    x = rng.randn(n, 3).astype(np.float32)
+    # imbalanced: 10% positives, noisy signal
+    y = ((x[:, 0] + 0.8 * rng.randn(n)) > 1.3).astype(np.float32)
+
+    def rate(spw):
+        m = GBDT(GBDTParam(num_boost_round=5, max_depth=3, num_bins=16,
+                           scale_pos_weight=spw, learning_rate=0.5),
+                 num_feature=3)
+        m.make_bins(x)
+        ens, margin = m.fit_binned(m.bin_features(x), y)
+        return float((np.asarray(margin) > 0).mean())
+
+    r1, r10 = rate(1.0), rate(10.0)
+    assert r10 > r1 + 0.05, (r1, r10)   # upweighting positives predicts
+                                        # positive far more often
+
+
+def test_scale_pos_weight_boost_round_consistent():
+    rng = np.random.RandomState(18)
+    x = rng.randn(1000, 3).astype(np.float32)
+    y = (x[:, 0] > 1.0).astype(np.float32)
+    import jax.numpy as jnp
+
+    m = GBDT(GBDTParam(num_boost_round=3, max_depth=3, num_bins=16,
+                       scale_pos_weight=4.0, learning_rate=0.5),
+             num_feature=3)
+    m.make_bins(x)
+    bins = jnp.asarray(np.asarray(m.bin_features(x), np.int32))
+    ens_fit, _ = m.fit_binned(bins, y)
+    margin = jnp.zeros(1000, jnp.float32)
+    w = jnp.ones(1000, jnp.float32)
+    sfs = []
+    for r in range(3):
+        margin, tree = m.boost_round(margin, bins, jnp.asarray(y), w,
+                                     round_index=r)
+        sfs.append(np.asarray(tree[0]))
+    np.testing.assert_array_equal(np.stack(sfs),
+                                  np.asarray(ens_fit.split_feat))
+
+
+def test_scale_pos_weight_rejected_off_logistic():
+    with pytest.raises(Exception, match="scale_pos_weight"):
+        GBDT(GBDTParam(objective="squared", scale_pos_weight=2.0),
+             num_feature=3)
+    with pytest.raises(Exception, match="scale_pos_weight"):
+        GBDT(GBDTParam(objective="softmax", num_class=3,
+                       scale_pos_weight=2.0), num_feature=3)
