@@ -63,6 +63,13 @@ class GBDTParam(Parameter):
     colsample_bytree = field(float, default=1.0, lower=1e-6, upper=1.0,
                              help="per-tree feature subsampling rate")
     seed = field(int, default=0, help="subsampling PRNG seed")
+    base_score = field(float, default=0.0,
+                       help="initial prediction margin (XGBoost base_score "
+                            "in margin space: its default 0.5 probability "
+                            "== margin 0 for logistic; for squared "
+                            "objectives set e.g. the label mean). "
+                            "Streaming boost_round callers must init "
+                            "their margin with it themselves")
     handle_missing = field(bool, default=False,
                            help="sparsity-aware splits: NaN features take a "
                                 "reserved bin and each split learns its "
@@ -572,7 +579,8 @@ class GBDT:
                 return _softmax_round(p, bins, margin, label, weight, rnd,
                                       grow, n_rows=n_rows)
 
-            margin0 = jnp.zeros((B,) if K == 1 else (B, K), jnp.float32)
+            margin0 = jnp.full((B,) if K == 1 else (B, K), p.base_score,
+                               jnp.float32)
             rounds = jnp.arange(num_rounds, dtype=jnp.uint32)
 
             if not with_eval:
@@ -599,8 +607,9 @@ class GBDT:
                 ev_loss = _logloss(ev_margin, ev_label, p.objective)
                 return (margin, ev_margin), (trees, tr_loss, ev_loss)
 
-            ev0 = jnp.zeros((ev_bins.shape[0],) if K == 1
-                            else (ev_bins.shape[0], K), jnp.float32)
+            ev0 = jnp.full((ev_bins.shape[0],) if K == 1
+                           else (ev_bins.shape[0], K), p.base_score,
+                           jnp.float32)
             (margin, _), (trees, trl, evl) = lax.scan(
                 eval_body, (margin0, ev0), rounds)
             return TreeEnsemble(*trees), margin[:n_rows], trl, evl
@@ -634,7 +643,9 @@ class GBDT:
 
             shape = ((B, ensemble.split_feat.shape[1]) if multiclass
                      else (B,))
-            out, _ = lax.scan(body, jnp.zeros(shape, jnp.float32),
+            out, _ = lax.scan(body,
+                              jnp.full(shape, self.param.base_score,
+                                       jnp.float32),
                               (ensemble.split_feat, ensemble.split_bin,
                                ensemble.leaf_value, ensemble.default_left))
             return out
@@ -761,14 +772,15 @@ class GBDT:
                 jnp.asarray(eval_label, jnp.float32), weight,
                 early_stopping_rounds)
         mshape = (bins.shape[0],) if K == 1 else (bins.shape[0], K)
-        margin = jnp.zeros(mshape, jnp.float32)
+        margin = jnp.full(mshape, self.param.base_score, jnp.float32)
         eval_margin = None
         if eval_bins is not None:
             eval_bins = jnp.asarray(eval_bins)
             eval_label = jnp.asarray(eval_label, jnp.float32)
             eshape = ((eval_bins.shape[0],) if K == 1
                       else (eval_bins.shape[0], K))
-            eval_margin = jnp.zeros(eshape, jnp.float32)
+            eval_margin = jnp.full(eshape, self.param.base_score,
+                                   jnp.float32)
         trees = []
         history = []
         stopper = _EarlyStop(early_stopping_rounds)
@@ -854,7 +866,8 @@ class GBDT:
                 margin = margin + delta
                 return margin, _logloss(margin, label, p.objective)
 
-            margin0 = jnp.zeros((B,) if K == 1 else (B, K), jnp.float32)
+            margin0 = jnp.full((B,) if K == 1 else (B, K), p.base_score,
+                               jnp.float32)
             _, losses = lax.scan(body, margin0,
                                  (ensemble.split_feat, ensemble.split_bin,
                                   ensemble.leaf_value,
@@ -996,6 +1009,9 @@ class GBDT:
             # missing-mode would silently mis-bin NaNs and ignore the
             # learned default directions — record it so load can refuse
             "handle_missing": np.array([int(self.param.handle_missing)]),
+            # predict-time contract: _predict_fn adds the loader's
+            # base_score, so a mismatch silently shifts every margin
+            "base_score": np.array([self.param.base_score], np.float32),
         }
         # omit absent stats (ensembles loaded from pre-stats checkpoints):
         # np.asarray(None) would write an object-dtype leaf that can never
@@ -1055,6 +1071,13 @@ class GBDT:
               f"GBDT has handle_missing={self.param.handle_missing}; the "
               f"binning and routing contracts differ — construct the "
               f"loader with the matching GBDTParam")
+        bs = get("base_score", default=None)
+        saved_bs = float(bs[0]) if bs is not None else 0.0
+        CHECK(abs(saved_bs - self.param.base_score) < 1e-9,
+              f"model was saved with base_score={saved_bs} but this GBDT "
+              f"has base_score={self.param.base_score}; predictions would "
+              f"silently shift — construct the loader with the matching "
+              f"GBDTParam")
         sg = get("split_gain", default=None)
         sc = get("split_cover", default=None)
         return TreeEnsemble(sf, get("split_bin"), get("leaf_value"), dl,

@@ -539,3 +539,53 @@ def test_scale_pos_weight_rejected_off_logistic():
     with pytest.raises(Exception, match="scale_pos_weight"):
         GBDT(GBDTParam(objective="softmax", num_class=3,
                        scale_pos_weight=2.0), num_feature=3)
+
+
+def test_base_score():
+    rng = np.random.RandomState(19)
+    x = rng.randn(1500, 3).astype(np.float32)
+    y = (x[:, 0] * 2 + 10.0 + 0.1 * rng.randn(1500)).astype(np.float32)
+
+    def fit(bs, rounds=3):
+        m = GBDT(GBDTParam(num_boost_round=rounds, max_depth=3, num_bins=16,
+                           objective="squared", learning_rate=0.3,
+                           base_score=bs), num_feature=3)
+        m.make_bins(x)
+        ens, margin = m.fit_binned(m.bin_features(x), y)
+        return m, ens, np.asarray(margin)
+
+    # offset targets: starting at the label mean converges far faster
+    m0, ens0, mar0 = fit(0.0)
+    mb, ensb, marb = fit(float(y.mean()))
+    assert ((marb - y) ** 2).mean() < ((mar0 - y) ** 2).mean() / 2
+    # fit margin and predict agree (both include base_score)
+    np.testing.assert_allclose(
+        np.asarray(mb.predict_margin(ensb, mb.bin_features(x))), marb,
+        rtol=1e-5, atol=1e-5)
+    # staged losses include it too: last staged loss == final fit loss
+    staged = mb.staged_losses(ensb, np.asarray(mb.bin_features(x)), y)
+    assert abs(staged[-1] - ((marb - y) ** 2).mean()) < 1e-3
+
+
+def test_base_score_persisted_and_checked(tmp_path):
+    rng = np.random.RandomState(20)
+    x = rng.randn(500, 3).astype(np.float32)
+    y = (x[:, 0] + 5.0).astype(np.float32)
+    m = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=8,
+                       objective="squared", base_score=5.0), num_feature=3)
+    m.make_bins(x)
+    ens, _ = m.fit_binned(m.bin_features(x), y)
+    uri = str(tmp_path / "bs.bin")
+    m.save_model(uri, ens)
+    # matching loader round-trips
+    m2 = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=8,
+                        objective="squared", base_score=5.0), num_feature=3)
+    ens2 = m2.load_model(uri)
+    np.testing.assert_allclose(
+        np.asarray(m2.predict_margin(ens2, m2.bin_features(x))),
+        np.asarray(m.predict_margin(ens, m.bin_features(x))), rtol=1e-6)
+    # mismatched loader refuses instead of silently shifting margins
+    plain = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=8,
+                           objective="squared"), num_feature=3)
+    with pytest.raises(Exception, match="base_score"):
+        plain.load_model(uri)
