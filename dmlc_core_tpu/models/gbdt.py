@@ -63,6 +63,12 @@ class GBDTParam(Parameter):
     colsample_bytree = field(float, default=1.0, lower=1e-6, upper=1.0,
                              help="per-tree feature subsampling rate")
     seed = field(int, default=0, help="subsampling PRNG seed")
+    monotone_constraints = field(str, default="",
+                                 help="per-feature monotone directions, "
+                                      "XGBoost style: '(1,0,-1,...)' or "
+                                      "'1,0,-1' — +1 non-decreasing, -1 "
+                                      "non-increasing, 0 free; empty "
+                                      "disables")
     base_score = field(float, default=0.0,
                        help="initial prediction margin (XGBoost base_score "
                             "in margin space: its default 0.5 probability "
@@ -169,11 +175,33 @@ def _check_softmax_labels(label, num_class: int, what: str = "labels"):
           f"got range [{host.min()}, {host.max()}]")
 
 
+def _parse_monotone(spec: str, num_feature: int):
+    """'(1,0,-1)' / '1,0,-1' -> int32 [F] array, or None when empty/all
+    zero (the zero-cost legacy path).  Empty entries are rejected — a
+    dropped comma slot would silently shift every later constraint onto
+    the wrong feature."""
+    spec = (spec or "").strip().strip("()")
+    if not spec:
+        return None
+    parts = spec.replace(" ", "").split(",")
+    CHECK(all(v != "" for v in parts),
+          f"monotone_constraints has an empty entry: {spec!r}")
+    vals = [int(v) for v in parts]
+    CHECK(len(vals) == num_feature,
+          f"monotone_constraints has {len(vals)} entries for "
+          f"{num_feature} features")
+    CHECK(all(v in (-1, 0, 1) for v in vals),
+          f"monotone_constraints entries must be -1/0/+1, got {vals}")
+    arr = np.asarray(vals, np.int32)
+    return None if not arr.any() else arr
+
+
 def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 min_child_weight: float, learning_rate: float,
                 model_axis: Optional[str] = None, method: str = "scatter",
                 onehot=None, min_split_loss: float = 0.0, feat_mask=None,
-                missing: bool = False, reg_alpha: float = 0.0):
+                missing: bool = False, reg_alpha: float = 0.0,
+                monotone=None):
     """Grow one tree level-by-level; returns (split_feat, split_bin,
     leaf_value, default_left, split_gain, split_cover, margin_delta).
     Pure jax, shapes static in (max_depth, num_bins, F).
@@ -187,6 +215,16 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     cumsums — missing mass on the left vs on the right — and the better
     direction is stored per node in ``default_left``.  The histogram
     kernels are untouched: the missing bin is just the last bin.
+
+    ``monotone`` ([F] int in {-1, 0, +1}, or None) enforces monotone
+    response per feature the XGBoost way: candidate splits whose child
+    weights violate the direction are masked, every node carries a
+    [lower, upper] weight interval, children of a constrained split split
+    that interval at the clamped midpoint, and leaf weights clamp into
+    their interval — together these guarantee monotonic predictions.
+    (Gains are scored on unclamped weights — a mild difference from
+    XGBoost's clamp-aware scoring that affects split choice, never the
+    monotonicity guarantee.)
     """
     import jax.numpy as jnp
 
@@ -200,6 +238,12 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     node = jnp.zeros((B,), dtype=jnp.int32)  # node id within the level
     fiota = jnp.arange(F, dtype=jnp.int32)
     miss_id = num_bins - 1
+    if monotone is not None:
+        mono = jnp.asarray(monotone, jnp.int32)          # [F]
+        # per-node weight interval, split at the midpoint on constrained
+        # splits (XGBoost's bound propagation)
+        node_lo = jnp.full((1,), -jnp.inf, jnp.float32)
+        node_hi = jnp.full((1,), jnp.inf, jnp.float32)
 
     for depth in range(max_depth):
         n_nodes = 2 ** depth
@@ -215,6 +259,11 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
 
         GTa = _l1_threshold(GT, reg_alpha)
 
+        def _weights(GLv, HLv):
+            wl = -_l1_threshold(GLv, reg_alpha) / (HLv + lam)
+            wr = -_l1_threshold(GT - GLv, reg_alpha) / (HT - HLv + lam)
+            return wl, wr
+
         def _gain(GLv, HLv):
             GRv = GT - GLv
             HRv = HT - HLv
@@ -223,6 +272,10 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
             gn = (GLa ** 2 / (HLv + lam) + GRa ** 2 / (HRv + lam)
                   - GTa ** 2 / (HT + lam))               # [n, F, nbins]
             ok = (HLv >= min_child_weight) & (HRv >= min_child_weight)
+            if monotone is not None:
+                wl, wr = _weights(GLv, HLv)
+                c = mono[None, :, None]
+                ok = ok & ~(c * (wl - wr) > 0)           # violating splits
             return gn, ok
 
         gain, valid = _gain(GL, HL)
@@ -265,6 +318,38 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
             jnp.where(do_split, best_gain, 0.0))
         split_cover = split_cover.at[lvl].set(
             jnp.where(do_split, HT[:, 0, 0], 0.0))
+        if monotone is not None:
+            # child intervals: the chosen split's child weights set the
+            # midpoint; constrained features split the node interval there
+            def _at_best(a):
+                return jnp.take_along_axis(
+                    a.reshape(n_nodes, F * num_bins), best[:, None],
+                    axis=-1)[:, 0]
+
+            # gather the chosen split's sums first: wl/wr become
+            # [n]-sized math instead of full [n, F, nbins] passes
+            GLb, HLb = _at_best(GL), _at_best(HL)
+            if missing:
+                GLb = jnp.where(dl, _at_best(GL + G[..., miss_id:miss_id + 1]),
+                                GLb)
+                HLb = jnp.where(dl, _at_best(HL + H[..., miss_id:miss_id + 1]),
+                                HLb)
+            GTn, HTn = GT[:, 0, 0], HT[:, 0, 0]
+            wl = -_l1_threshold(GLb, reg_alpha) / (HLb + lam)
+            wr = -_l1_threshold(GTn - GLb, reg_alpha) / (HTn - HLb + lam)
+            wl = jnp.clip(wl, node_lo, node_hi)
+            wr = jnp.clip(wr, node_lo, node_hi)
+            mid = 0.5 * (wl + wr)
+            c_node = jnp.where(do_split, mono[bf], 0)    # [n]
+            # c=+1: left subtree weights <= mid <= right subtree weights
+            lo_l = node_lo
+            hi_l = jnp.where(c_node > 0, jnp.minimum(node_hi, mid), node_hi)
+            lo_r = jnp.where(c_node > 0, jnp.maximum(node_lo, mid), node_lo)
+            hi_r = node_hi
+            lo_l = jnp.where(c_node < 0, jnp.maximum(node_lo, mid), lo_l)
+            hi_r = jnp.where(c_node < 0, jnp.minimum(node_hi, mid), hi_r)
+            node_lo = jnp.stack([lo_l, lo_r], axis=1).reshape(-1)
+            node_hi = jnp.stack([hi_l, hi_r], axis=1).reshape(-1)
         # advance every row one level.  The per-row feature pick is a
         # compare-select-reduce over the (28-lane) feature axis, NOT a
         # take_along_axis gather: profiled on v5e the gather lowering costs
@@ -295,8 +380,10 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     else:
         Gl = jax.ops.segment_sum(g, node, num_segments=n_leaf)
         Hl = jax.ops.segment_sum(h, node, num_segments=n_leaf)
-    leaf_value = (-_l1_threshold(Gl, reg_alpha)
-                  / (Hl + reg_lambda)) * learning_rate
+    leaf_w = -_l1_threshold(Gl, reg_alpha) / (Hl + reg_lambda)
+    if monotone is not None:
+        leaf_w = jnp.clip(leaf_w, node_lo, node_hi)
+    leaf_value = leaf_w * learning_rate
     margin_delta = leaf_value[node]
     return (split_feat, split_bin, leaf_value, default_left, split_gain,
             split_cover, margin_delta)
@@ -408,6 +495,8 @@ class GBDT:
               f"scale_pos_weight={param.scale_pos_weight} only applies to "
               f"objective=logistic (got {param.objective!r}); it would "
               f"silently do nothing here")
+        self._monotone = _parse_monotone(param.monotone_constraints,
+                                         num_feature)
         self.param = param
         self.num_feature = num_feature
         self.model_axis = model_axis
@@ -497,7 +586,8 @@ class GBDT:
                     p.min_child_weight, p.learning_rate, self.model_axis,
                     method=method, onehot=onehot,
                     min_split_loss=p.min_split_loss, feat_mask=fmask,
-                    missing=p.handle_missing, reg_alpha=p.reg_alpha)
+                    missing=p.handle_missing, reg_alpha=p.reg_alpha,
+                    monotone=self._monotone)
 
             if p.objective == "softmax":
                 return _softmax_round(p, bins, margin, label, weight, rnd,
@@ -565,7 +655,8 @@ class GBDT:
                     p.min_child_weight, p.learning_rate, self.model_axis,
                     method=method, onehot=onehot,
                     min_split_loss=p.min_split_loss, feat_mask=fmask,
-                    missing=p.handle_missing, reg_alpha=p.reg_alpha)
+                    missing=p.handle_missing, reg_alpha=p.reg_alpha,
+                    monotone=self._monotone)
 
             def round_step(margin, rnd):
                 if K == 1:
