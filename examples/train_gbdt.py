@@ -38,6 +38,8 @@ def main():
                     help="gamma: minimum gain to split")
     ap.add_argument("--reg-alpha", type=float, default=0.0,
                     help="L1 on leaf weights")
+    ap.add_argument("--monotone-constraints", default="",
+                    help="per-feature directions, e.g. '(1,0,-1)'")
     ap.add_argument("--scale-pos-weight", type=float, default=1.0,
                     help="positive-class weight multiplier (logistic)")
     ap.add_argument("--subsample", type=float, default=1.0)
@@ -101,6 +103,7 @@ def main():
                       hist_method=args.hist_method,
                       min_split_loss=args.min_split_loss,
                       reg_alpha=args.reg_alpha,
+                      monotone_constraints=args.monotone_constraints,
                       scale_pos_weight=args.scale_pos_weight,
                       subsample=args.subsample,
                       colsample_bytree=args.colsample_bytree, seed=args.seed,
