@@ -62,6 +62,17 @@ class GBDTParam(Parameter):
                       help="per-tree row subsampling rate")
     colsample_bytree = field(float, default=1.0, lower=1e-6, upper=1.0,
                              help="per-tree feature subsampling rate")
+    colsample_bylevel = field(float, default=1.0, lower=1e-6, upper=1.0,
+                              help="per-level feature subsampling rate "
+                                   "(draws a fresh mask every tree depth, "
+                                   "composed with colsample_bytree; a "
+                                   "softmax round's K trees share the "
+                                   "level draw)")
+    max_delta_step = field(float, default=0.0, lower=0.0,
+                           help="cap on |leaf weight| before shrinkage "
+                                "(XGBoost's imbalanced-logistic stabiliser; "
+                                "0 disables). Applied to leaf values only, "
+                                "not to gain scoring")
     seed = field(int, default=0, help="subsampling PRNG seed")
     monotone_constraints = field(str, default="",
                                  help="per-feature monotone directions, "
@@ -201,7 +212,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 model_axis: Optional[str] = None, method: str = "scatter",
                 onehot=None, min_split_loss: float = 0.0, feat_mask=None,
                 missing: bool = False, reg_alpha: float = 0.0,
-                monotone=None):
+                monotone=None, level_mask_fn=None,
+                max_delta_step: float = 0.0):
     """Grow one tree level-by-level; returns (split_feat, split_bin,
     leaf_value, default_left, split_gain, split_cover, margin_delta).
     Pure jax, shapes static in (max_depth, num_bins, F).
@@ -296,6 +308,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         valid = valid & (jnp.arange(num_bins) < num_bins - 1)[None, None, :]
         if feat_mask is not None:
             valid = valid & feat_mask[None, :, None]
+        if level_mask_fn is not None:
+            valid = valid & level_mask_fn(depth)[None, :, None]
         gain = jnp.where(valid, gain, -jnp.inf)
         flat = gain.reshape(n_nodes, F * num_bins)
         best = jnp.argmax(flat, axis=-1)                 # [n]
@@ -381,6 +395,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         Gl = jax.ops.segment_sum(g, node, num_segments=n_leaf)
         Hl = jax.ops.segment_sum(h, node, num_segments=n_leaf)
     leaf_w = -_l1_threshold(Gl, reg_alpha) / (Hl + reg_lambda)
+    if max_delta_step > 0.0:
+        leaf_w = jnp.clip(leaf_w, -max_delta_step, max_delta_step)
     if monotone is not None:
         leaf_w = jnp.clip(leaf_w, node_lo, node_hi)
     leaf_value = leaf_w * learning_rate
@@ -415,6 +431,28 @@ def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int, class_index: int = 0):
             # never mask every feature: the cheapest column always stays
             fmask = fmask.at[jnp.argmin(u)].set(True)
     return row_w, fmask
+
+
+def _level_mask_fn(p, rnd, F: int):
+    """colsample_bylevel: a fresh feature mask per tree depth, seeded by
+    (seed, rnd, depth) — deterministic, trace-safe, never empty (the
+    cheapest column always stays).  None at rate 1.0.  A softmax round's
+    K trees share the draw (the grow closure has no class identity)."""
+    if p.colsample_bylevel >= 1.0:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    base = jax.random.fold_in(jax.random.PRNGKey(p.seed),
+                              jnp.asarray(rnd, jnp.uint32))
+    base = jax.random.fold_in(base, 7)   # domain-separate from row/col draws
+
+    def mask(depth: int):
+        u = jax.random.uniform(jax.random.fold_in(base, depth), (F,))
+        m = u < p.colsample_bylevel
+        return m.at[jnp.argmin(u)].set(True)
+
+    return mask
 
 
 def _row_sampling(p, rnd, n_rows: int, B: int, F: int, class_index=0):
@@ -587,7 +625,9 @@ class GBDT:
                     method=method, onehot=onehot,
                     min_split_loss=p.min_split_loss, feat_mask=fmask,
                     missing=p.handle_missing, reg_alpha=p.reg_alpha,
-                    monotone=self._monotone)
+                    monotone=self._monotone,
+                    level_mask_fn=_level_mask_fn(p, rnd_, bins_.shape[1]),
+                    max_delta_step=p.max_delta_step)
 
             if p.objective == "softmax":
                 return _softmax_round(p, bins, margin, label, weight, rnd,
@@ -656,7 +696,9 @@ class GBDT:
                     method=method, onehot=onehot,
                     min_split_loss=p.min_split_loss, feat_mask=fmask,
                     missing=p.handle_missing, reg_alpha=p.reg_alpha,
-                    monotone=self._monotone)
+                    monotone=self._monotone,
+                    level_mask_fn=_level_mask_fn(p, rnd, bins_.shape[1]),
+                    max_delta_step=p.max_delta_step)
 
             def round_step(margin, rnd):
                 if K == 1:
@@ -774,10 +816,11 @@ class GBDT:
 
         if round_index is None:
             CHECK(self.param.subsample >= 1.0
-                  and self.param.colsample_bytree >= 1.0,
+                  and self.param.colsample_bytree >= 1.0
+                  and self.param.colsample_bylevel >= 1.0,
                   "boost_round needs round_index= when subsample/"
-                  "colsample_bytree are enabled (each tree must draw a "
-                  "fresh subset)")
+                  "colsample_bytree/colsample_bylevel are enabled (each "
+                  "tree must draw fresh subsets)")
             round_index = 0
         weight = _apply_pos_weight(jnp.asarray(weight),
                                    jnp.asarray(label), self.param)

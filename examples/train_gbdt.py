@@ -44,6 +44,8 @@ def main():
                     help="positive-class weight multiplier (logistic)")
     ap.add_argument("--subsample", type=float, default=1.0)
     ap.add_argument("--colsample-bytree", type=float, default=1.0)
+    ap.add_argument("--colsample-bylevel", type=float, default=1.0)
+    ap.add_argument("--max-delta-step", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--handle-missing", action="store_true",
                     help="sparsity-aware splits: absent/NaN features take "
@@ -106,7 +108,9 @@ def main():
                       monotone_constraints=args.monotone_constraints,
                       scale_pos_weight=args.scale_pos_weight,
                       subsample=args.subsample,
-                      colsample_bytree=args.colsample_bytree, seed=args.seed,
+                      colsample_bytree=args.colsample_bytree,
+                      colsample_bylevel=args.colsample_bylevel,
+                      max_delta_step=args.max_delta_step, seed=args.seed,
                       objective=args.objective, num_class=args.num_class,
                       handle_missing=args.handle_missing)
     model = GBDT(param, num_feature=args.num_feature)

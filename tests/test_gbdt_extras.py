@@ -661,3 +661,74 @@ def test_monotone_spec_validation():
     # all-zero spec is the legacy path
     m = GBDT(GBDTParam(monotone_constraints="(0,0,0)"), num_feature=3)
     assert m._monotone is None
+
+
+def test_colsample_bylevel():
+    rng = np.random.RandomState(24)
+    x = rng.randn(2000, 8).astype(np.float32)
+    y = (x[:, 0] + x[:, 3] > 0).astype(np.float32)
+
+    def fit(rate, seed=0):
+        m = GBDT(GBDTParam(num_boost_round=4, max_depth=4, num_bins=16,
+                           colsample_bylevel=rate, seed=seed,
+                           learning_rate=0.5), num_feature=8)
+        m.make_bins(x)
+        ens, margin = m.fit_binned(m.bin_features(x), y)
+        return ens, margin
+
+    e_half, m_half = fit(0.5)
+    e_full, _ = fit(1.0)
+    # masking changes the trees, deterministically per seed
+    assert not np.array_equal(np.asarray(e_half.split_feat),
+                              np.asarray(e_full.split_feat))
+    e_again, _ = fit(0.5)
+    np.testing.assert_array_equal(np.asarray(e_half.split_feat),
+                                  np.asarray(e_again.split_feat))
+    # and it still learns
+    acc = float(((np.asarray(m_half) > 0) == y).mean())
+    assert acc > 0.9, acc
+    # round-by-round path draws the same masks (keyed on seed/round/depth)
+    import jax.numpy as jnp
+
+    m2 = GBDT(GBDTParam(num_boost_round=4, max_depth=4, num_bins=16,
+                        colsample_bylevel=0.5, seed=0, learning_rate=0.5),
+              num_feature=8)
+    m2.make_bins(x)
+    bins = jnp.asarray(np.asarray(m2.bin_features(x), np.int32))
+    margin = jnp.zeros(2000, jnp.float32)
+    w = jnp.ones(2000, jnp.float32)
+    sfs = []
+    for r in range(4):
+        margin, tree = m2.boost_round(margin, bins, jnp.asarray(y), w,
+                                      round_index=r)
+        sfs.append(np.asarray(tree[0]))
+    np.testing.assert_array_equal(np.stack(sfs),
+                                  np.asarray(e_half.split_feat))
+
+
+def test_max_delta_step_caps_leaves():
+    rng = np.random.RandomState(25)
+    x = rng.randn(1000, 3).astype(np.float32)
+    y = (x[:, 0] > 2.2).astype(np.float32)      # extreme imbalance
+    lr = 0.5
+
+    def leaves(mds):
+        m = GBDT(GBDTParam(num_boost_round=3, max_depth=3, num_bins=16,
+                           learning_rate=lr, max_delta_step=mds),
+                 num_feature=3)
+        m.make_bins(x)
+        ens, _ = m.fit_binned(m.bin_features(x), y)
+        return np.abs(np.asarray(ens.leaf_value))
+
+    assert leaves(0.7).max() <= 0.7 * lr + 1e-6
+    assert leaves(0.0).max() > 0.7 * lr        # uncapped would exceed it
+
+
+def test_boost_round_requires_round_index_under_bylevel():
+    m = GBDT(GBDTParam(colsample_bylevel=0.5, max_depth=2, num_bins=8),
+             num_feature=4)
+    import jax.numpy as jnp
+
+    with pytest.raises(Exception, match="round_index"):
+        m.boost_round(jnp.zeros(8), jnp.zeros((8, 4), jnp.int32),
+                      jnp.zeros(8), jnp.ones(8))
