@@ -1,0 +1,81 @@
+"""Model-quality anchor: our hist GBDT vs scikit-learn's
+HistGradientBoosting on shared holdouts.
+
+Not a bitwise comparison — different growth policies — but the holdout
+metrics must land in the same band: a systematic quality gap would mean
+the TPU recast broke the learning algorithm, not just reordered floats.
+(The reference repo has no such external anchor; this is the rebuild's
+equivalent of validating against the ecosystem's production learner.)
+"""
+
+import numpy as np
+import pytest
+
+sklearn = pytest.importorskip("sklearn")
+
+from sklearn.ensemble import (HistGradientBoostingClassifier,
+                              HistGradientBoostingRegressor)
+
+from dmlc_core_tpu.models.sklearn import GBDTClassifier, GBDTRegressor
+
+COMMON = dict(num_boost_round=40, max_depth=6, num_bins=64,
+              learning_rate=0.2)
+SK_COMMON = dict(max_iter=40, max_depth=6, max_bins=63,
+                 learning_rate=0.2, early_stopping=False)
+
+
+def _holdout(n, F, seed, make_y):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, F).astype(np.float32)
+    y = make_y(rng, x)
+    cut = int(n * 0.8)
+    return (x[:cut], y[:cut]), (x[cut:], y[cut:])
+
+
+def test_binary_classification_parity():
+    (xt, yt), (xv, yv) = _holdout(
+        8000, 8, 0,
+        lambda rng, x: ((x[:, 0] * x[:, 1] + np.sin(2 * x[:, 2])
+                         + 0.5 * rng.randn(len(x))) > 0).astype(int))
+    ours = GBDTClassifier(**COMMON).fit(xt, yt).score(xv, yv)
+    theirs = HistGradientBoostingClassifier(**SK_COMMON).fit(
+        xt, yt).score(xv, yv)
+    assert ours > theirs - 0.03, (ours, theirs)
+
+
+def test_regression_parity():
+    (xt, yt), (xv, yv) = _holdout(
+        8000, 6, 1,
+        lambda rng, x: (x[:, 0] ** 2 - 2 * x[:, 1] + x[:, 2] * x[:, 3]
+                        + 0.3 * rng.randn(len(x))).astype(np.float32))
+    ours = GBDTRegressor(**COMMON).fit(xt, yt).score(xv, yv)
+    theirs = HistGradientBoostingRegressor(**SK_COMMON).fit(
+        xt, yt).score(xv, yv)
+    assert ours > theirs - 0.05, (ours, theirs)
+
+
+def test_missing_values_parity():
+    """Both learners treat NaN as first-class missing; quality must hold
+    on missing-informative data."""
+    def make(rng, x):
+        y = ((x[:, 0] + 0.5 * rng.randn(len(x))) > 0).astype(int)
+        x[(y == 1) & (rng.rand(len(x)) < 0.6), 1] = np.nan   # informative
+        x[rng.rand(len(x)) < 0.1, 2] = np.nan                # noise missing
+        return y
+
+    (xt, yt), (xv, yv) = _holdout(8000, 5, 2, make)
+    ours = GBDTClassifier(**COMMON).fit(xt, yt).score(xv, yv)
+    theirs = HistGradientBoostingClassifier(**SK_COMMON).fit(
+        xt, yt).score(xv, yv)
+    assert ours > theirs - 0.03, (ours, theirs)
+
+
+def test_multiclass_parity():
+    (xt, yt), (xv, yv) = _holdout(
+        8000, 6, 3,
+        lambda rng, x: ((x[:, 0] > 0).astype(int)
+                        + (x[:, 1] * x[:, 2] > 0).astype(int)))
+    ours = GBDTClassifier(**COMMON).fit(xt, yt).score(xv, yv)
+    theirs = HistGradientBoostingClassifier(**SK_COMMON).fit(
+        xt, yt).score(xv, yv)
+    assert ours > theirs - 0.04, (ours, theirs)
