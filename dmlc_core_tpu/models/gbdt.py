@@ -494,9 +494,9 @@ def _softmax_round(p, bins, margin, label, weight, rnd, grow,
         jnp.stack([t[i] for t in trees]) for i in range(6))
 
 
-def _predict_tree(split_feat, split_bin, leaf_value, default_left, bins,
-                  max_depth: int, miss_id: int = -1):
-    """Route every row down one tree with static-depth gathers.
+def _route_tree(split_feat, split_bin, default_left, bins,
+                max_depth: int, miss_id: int = -1):
+    """Leaf slot of every row in one tree (static-depth gathers).
 
     ``miss_id`` >= 0 enables sparsity-aware routing: rows whose split
     feature carries that bin follow the node's learned default direction
@@ -519,7 +519,27 @@ def _predict_tree(split_feat, split_bin, leaf_value, default_left, bins,
             dl = default_left[level_off + node]
             go_right = go_right & ~((row_bin == miss_id) & dl)
         node = node * 2 + go_right.astype(jnp.int32)
-    return leaf_value[node]
+    return node
+
+
+def _predict_tree(split_feat, split_bin, leaf_value, default_left, bins,
+                  max_depth: int, miss_id: int = -1):
+    """Route every row down one tree and read its leaf value."""
+    return leaf_value[_route_tree(split_feat, split_bin, default_left, bins,
+                                  max_depth, miss_id)]
+
+
+def _per_tree(fn, arrays, multiclass: bool):
+    """Apply a per-tree function over one round's arrays, stacking the K
+    class trees on axis 1 for softmax ensembles — the single definition of
+    the multiclass tree layout used by predict / staged losses / leaves."""
+    import jax.numpy as jnp
+
+    if multiclass:
+        K = arrays[0].shape[0]
+        return jnp.stack([fn(*(a[k] for a in arrays)) for k in range(K)],
+                         axis=1)
+    return fn(*arrays)
 
 
 class GBDT:
@@ -764,14 +784,10 @@ class GBDT:
             multiclass = ensemble.split_feat.ndim == 3
 
             def body(acc, tree):
-                sf, sb, lv, dl = tree
-                if multiclass:
-                    delta = jnp.stack(
-                        [_predict_tree(sf[k], sb[k], lv[k], dl[k], bins, d,
-                                       miss_id)
-                         for k in range(sf.shape[0])], axis=1)
-                else:
-                    delta = _predict_tree(sf, sb, lv, dl, bins, d, miss_id)
+                delta = _per_tree(
+                    lambda sf, sb, lv, dl: _predict_tree(sf, sb, lv, dl,
+                                                         bins, d, miss_id),
+                    tree, multiclass)
                 return acc + delta, None
 
             shape = ((B, ensemble.split_feat.shape[1]) if multiclass
@@ -990,13 +1006,10 @@ class GBDT:
             B = bins.shape[0]
 
             def body(margin, tree):
-                sf, sb, lv, dl = tree
-                if K == 1:
-                    delta = _predict_tree(sf, sb, lv, dl, bins, d, miss_id)
-                else:
-                    delta = jnp.stack(
-                        [_predict_tree(sf[k], sb[k], lv[k], dl[k], bins, d,
-                                       miss_id) for k in range(K)], axis=1)
+                delta = _per_tree(
+                    lambda sf, sb, lv, dl: _predict_tree(sf, sb, lv, dl,
+                                                         bins, d, miss_id),
+                    tree, K > 1)
                 margin = margin + delta
                 return margin, _logloss(margin, label, p.objective)
 
@@ -1009,6 +1022,44 @@ class GBDT:
             return losses
 
         return jax.jit(staged)
+
+    @functools.lru_cache(maxsize=None)
+    def _predict_leaf_fn(self):
+        import jax
+        import jax.lax as lax
+        import jax.numpy as jnp
+
+        d = self.param.max_depth
+        miss_id = (self.param.num_bins - 1 if self.param.handle_missing
+                   else -1)
+
+        def leaves(ensemble, bins):
+            multiclass = ensemble.split_feat.ndim == 3
+
+            def body(_, tree):
+                out = _per_tree(
+                    lambda sf, sb, dl: _route_tree(sf, sb, dl, bins, d,
+                                                   miss_id),
+                    tree, multiclass)
+                return 0, out
+
+            _, ids = lax.scan(body, 0,
+                              (ensemble.split_feat, ensemble.split_bin,
+                               ensemble.default_left))
+            # scan stacks on axis 0 ([T, B(, K)]); XGBoost's pred_leaf is
+            # row-major [B, T(, K)]
+            return jnp.moveaxis(ids, 0, 1)
+
+        return jax.jit(leaves)
+
+    def predict_leaf(self, ensemble: TreeEnsemble, bins) -> np.ndarray:
+        """Leaf index of every row in every tree (XGBoost pred_leaf):
+        int32 [B, T] (or [B, T, K] for softmax), ids in [0, 2**max_depth).
+        The standard input for leaf-embedding feature engineering."""
+        import jax.numpy as jnp
+
+        return np.asarray(self._predict_leaf_fn()(ensemble,
+                                                  jnp.asarray(bins)))
 
     def staged_losses(self, ensemble: TreeEnsemble, bins, label) -> np.ndarray:
         """Per-round cumulative loss of the ensemble on any dataset — the

@@ -732,3 +732,35 @@ def test_boost_round_requires_round_index_under_bylevel():
     with pytest.raises(Exception, match="round_index"):
         m.boost_round(jnp.zeros(8), jnp.zeros((8, 4), jnp.int32),
                       jnp.zeros(8), jnp.ones(8))
+
+
+def test_predict_leaf(model_and_data):
+    model, bins, y, _, _ = model_and_data
+    ens, _ = model.fit_binned(bins, y)
+    leaves = np.asarray(model.predict_leaf(ens, bins))
+    B = np.asarray(bins).shape[0]
+    assert leaves.shape == (B, ens.num_trees)
+    assert leaves.dtype == np.int32
+    assert leaves.min() >= 0
+    assert leaves.max() < 2 ** model.param.max_depth
+    # leaf ids must be consistent with predictions: summing each row's
+    # leaf values reproduces the margin
+    lv = np.asarray(ens.leaf_value)
+    recon = (sum(lv[t][leaves[:, t]] for t in range(ens.num_trees))
+             + model.param.base_score)
+    np.testing.assert_allclose(
+        recon, np.asarray(model.predict_margin(ens, bins)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_predict_leaf_multiclass():
+    rng = np.random.RandomState(26)
+    x = rng.randn(500, 3).astype(np.float32)
+    y = rng.randint(0, 3, 500).astype(np.float32)
+    m = GBDT(GBDTParam(num_boost_round=2, max_depth=2, num_bins=8,
+                       objective="softmax", num_class=3), num_feature=3)
+    m.make_bins(x)
+    bins = m.bin_features(x)
+    ens, _ = m.fit_binned(bins, y)
+    leaves = np.asarray(m.predict_leaf(ens, bins))
+    assert leaves.shape == (500, 2, 3)
