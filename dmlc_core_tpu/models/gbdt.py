@@ -68,6 +68,11 @@ class GBDTParam(Parameter):
                                    "composed with colsample_bytree; a "
                                    "softmax round's K trees share the "
                                    "level draw)")
+    colsample_bynode = field(float, default=1.0, lower=1e-6, upper=1.0,
+                             help="per-node feature subsampling rate "
+                                  "(fresh mask per (depth, node), composed "
+                                  "with the tree/level draws; softmax "
+                                  "rounds share it like bylevel)")
     max_delta_step = field(float, default=0.0, lower=0.0,
                            help="cap on |leaf weight| before shrinkage "
                                 "(XGBoost's imbalanced-logistic stabiliser; "
@@ -306,10 +311,12 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         # (with missing handling the last REAL threshold is num_bins - 2,
         # which separates non-missing from missing — allowed)
         valid = valid & (jnp.arange(num_bins) < num_bins - 1)[None, None, :]
-        if feat_mask is not None:
-            valid = valid & feat_mask[None, :, None]
         if level_mask_fn is not None:
-            valid = valid & level_mask_fn(depth)[None, :, None]
+            # the level/node draw consumes the tree mask (nested sampling)
+            valid = valid & level_mask_fn(depth, n_nodes,
+                                          feat_mask)[:, :, None]
+        elif feat_mask is not None:
+            valid = valid & feat_mask[None, :, None]
         gain = jnp.where(valid, gain, -jnp.inf)
         flat = gain.reshape(n_nodes, F * num_bins)
         best = jnp.argmax(flat, axis=-1)                 # [n]
@@ -434,11 +441,13 @@ def _tree_sampling(p: "GBDTParam", rnd, B: int, F: int, class_index: int = 0):
 
 
 def _level_mask_fn(p, rnd, F: int):
-    """colsample_bylevel: a fresh feature mask per tree depth, seeded by
-    (seed, rnd, depth) — deterministic, trace-safe, never empty (the
-    cheapest column always stays).  None at rate 1.0.  A softmax round's
-    K trees share the draw (the grow closure has no class identity)."""
-    if p.colsample_bylevel >= 1.0:
+    """colsample_bylevel / colsample_bynode: fresh feature masks per tree
+    depth (and per node for bynode), seeded by (seed, rnd, depth) —
+    deterministic, trace-safe, never empty (each node's cheapest column
+    always stays).  Returns ``mask(depth, n_nodes) -> [n_nodes, F]`` bool,
+    or None when both rates are 1.0.  A softmax round's K trees share the
+    draw (the grow closure has no class identity)."""
+    if p.colsample_bylevel >= 1.0 and p.colsample_bynode >= 1.0:
         return None
     import jax
     import jax.numpy as jnp
@@ -447,10 +456,26 @@ def _level_mask_fn(p, rnd, F: int):
                               jnp.asarray(rnd, jnp.uint32))
     base = jax.random.fold_in(base, 7)   # domain-separate from row/col draws
 
-    def mask(depth: int):
-        u = jax.random.uniform(jax.random.fold_in(base, depth), (F,))
-        m = u < p.colsample_bylevel
-        return m.at[jnp.argmin(u)].set(True)
+    def mask(depth: int, n_nodes: int, tree_mask=None):
+        # NESTED draws (XGBoost semantics): bylevel samples from the
+        # bytree survivors, bynode from the bylevel survivors — independent
+        # draws could intersect to an empty per-node feature set, silently
+        # truncating the node into a leaf
+        key = jax.random.fold_in(base, depth)
+        allowed = (tree_mask if tree_mask is not None
+                   else jnp.ones((F,), bool))
+        if p.colsample_bylevel < 1.0:
+            u = jnp.where(allowed, jax.random.uniform(key, (F,)), jnp.inf)
+            allowed = ((u < p.colsample_bylevel) & allowed
+                       ).at[jnp.argmin(u)].set(True)
+        m = jnp.broadcast_to(allowed[None, :], (n_nodes, F))
+        if p.colsample_bynode < 1.0:
+            un = jnp.where(allowed[None, :],
+                           jax.random.uniform(jax.random.fold_in(key, 1),
+                                              (n_nodes, F)), jnp.inf)
+            m = ((un < p.colsample_bynode) & m
+                 ).at[jnp.arange(n_nodes), jnp.argmin(un, axis=1)].set(True)
+        return m
 
     return mask
 
@@ -833,10 +858,11 @@ class GBDT:
         if round_index is None:
             CHECK(self.param.subsample >= 1.0
                   and self.param.colsample_bytree >= 1.0
-                  and self.param.colsample_bylevel >= 1.0,
+                  and self.param.colsample_bylevel >= 1.0
+                  and self.param.colsample_bynode >= 1.0,
                   "boost_round needs round_index= when subsample/"
-                  "colsample_bytree/colsample_bylevel are enabled (each "
-                  "tree must draw fresh subsets)")
+                  "colsample_by* are enabled (each tree must draw fresh "
+                  "subsets)")
             round_index = 0
         weight = _apply_pos_weight(jnp.asarray(weight),
                                    jnp.asarray(label), self.param)

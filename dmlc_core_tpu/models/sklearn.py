@@ -25,7 +25,7 @@ __all__ = ["GBDTClassifier", "GBDTRegressor"]
 _PARAM_KEYS = ("num_boost_round", "max_depth", "num_bins", "learning_rate",
                "reg_lambda", "reg_alpha", "min_child_weight",
                "min_split_loss", "subsample", "colsample_bytree",
-               "colsample_bylevel", "max_delta_step",
+               "colsample_bylevel", "colsample_bynode", "max_delta_step",
                "scale_pos_weight", "seed", "base_score",
                "monotone_constraints", "hist_method")
 

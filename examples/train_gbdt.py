@@ -45,6 +45,7 @@ def main():
     ap.add_argument("--subsample", type=float, default=1.0)
     ap.add_argument("--colsample-bytree", type=float, default=1.0)
     ap.add_argument("--colsample-bylevel", type=float, default=1.0)
+    ap.add_argument("--colsample-bynode", type=float, default=1.0)
     ap.add_argument("--max-delta-step", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--handle-missing", action="store_true",
@@ -110,6 +111,7 @@ def main():
                       subsample=args.subsample,
                       colsample_bytree=args.colsample_bytree,
                       colsample_bylevel=args.colsample_bylevel,
+                      colsample_bynode=args.colsample_bynode,
                       max_delta_step=args.max_delta_step, seed=args.seed,
                       objective=args.objective, num_class=args.num_class,
                       handle_missing=args.handle_missing)
