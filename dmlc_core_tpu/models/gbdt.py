@@ -693,14 +693,17 @@ class GBDT:
         return self._build_fit(num_rounds, method, with_eval=False)
 
     @functools.lru_cache(maxsize=None)
-    def _fit_eval_fn(self, num_rounds: int, method: str = "scatter"):
+    def _fit_eval_fn(self, num_rounds: int, method: str = "scatter",
+                     eval_metric: str = "loss"):
         """:meth:`_fit_fn` + per-round eval-margin accumulation and
         train/eval losses — the whole eval-tracked fit is ONE compiled
         program (the round-by-round host loop costs ~a round-trip per
         round; early stopping becomes a host post-pass over the losses)."""
-        return self._build_fit(num_rounds, method, with_eval=True)
+        return self._build_fit(num_rounds, method, with_eval=True,
+                               eval_metric=eval_metric)
 
-    def _build_fit(self, num_rounds: int, method: str, with_eval: bool):
+    def _build_fit(self, num_rounds: int, method: str, with_eval: bool,
+                   eval_metric: str = "loss"):
         """One jitted scan-fit builder serving both entry points — the
         training body (padding, sampling, grow) must never fork between
         the plain and eval-tracked fits."""
@@ -782,7 +785,8 @@ class GBDT:
                 # _logloss is unweighted)
                 tr_loss = _logloss(margin[:n_rows], label[:n_rows],
                                    p.objective)
-                ev_loss = _logloss(ev_margin, ev_label, p.objective)
+                ev_loss = _eval_metric_fn(eval_metric,
+                                          p.objective)(ev_margin, ev_label)
                 return (margin, ev_margin), (trees, tr_loss, ev_loss)
 
             ev0 = jnp.full((ev_bins.shape[0],) if K == 1
@@ -913,7 +917,7 @@ class GBDT:
 
     def fit_with_eval(self, bins, label, eval_bins=None, eval_label=None,
                       weight=None, early_stopping_rounds: int = 0,
-                      compiled: bool = True):
+                      compiled: bool = True, eval_metric: str = "loss"):
         """Boosting with validation loss tracking and early stopping.
 
         Returns (ensemble, history) where history is a list of per-round dicts
@@ -946,7 +950,7 @@ class GBDT:
             return self._fit_with_eval_compiled(
                 bins, label, jnp.asarray(eval_bins),
                 jnp.asarray(eval_label, jnp.float32), weight,
-                early_stopping_rounds)
+                early_stopping_rounds, eval_metric)
         mshape = (bins.shape[0],) if K == 1 else (bins.shape[0], K)
         margin = jnp.full(mshape, self.param.base_score, jnp.float32)
         eval_margin = None
@@ -960,6 +964,7 @@ class GBDT:
         trees = []
         history = []
         stopper = _EarlyStop(early_stopping_rounds)
+        metric_fn = _eval_metric_fn(eval_metric, self.param.objective)
         tree_margin = self._tree_margin_fn()
         for r in range(self.param.num_boost_round):
             margin, (sf, sb, lv, dl, sg, sc) = self.boost_round(
@@ -977,8 +982,7 @@ class GBDT:
                         [tree_margin(sf[k], sb[k], lv[k], dl[k], eval_bins)
                          for k in range(K)], axis=1)
                 eval_margin = eval_margin + delta
-                eval_loss = float(_logloss(eval_margin, eval_label,
-                                           self.param.objective))
+                eval_loss = float(metric_fn(eval_margin, eval_label))
                 entry["eval_loss"] = eval_loss
                 if stopper.update(r, eval_loss):
                     trees = trees[:stopper.best_round + 1]
@@ -989,7 +993,8 @@ class GBDT:
         return TreeEnsemble(*stacked), history
 
     def _fit_with_eval_compiled(self, bins, label, eval_bins, eval_label,
-                                weight, early_stopping_rounds: int):
+                                weight, early_stopping_rounds: int,
+                                eval_metric: str = "loss"):
         """One-jit eval-tracked fit + host-side sequential stopping rule
         (see :meth:`fit_with_eval`); returns identical (ensemble, history)
         to the round-by-round loop."""
@@ -998,7 +1003,7 @@ class GBDT:
         R = self.param.num_boost_round
         padded = -(-bins.shape[0] // BLOCK_ROWS) * BLOCK_ROWS
         method = self._method(bins, batch=padded)
-        ens, _, trl, evl = self._fit_eval_fn(R, method)(
+        ens, _, trl, evl = self._fit_eval_fn(R, method, eval_metric)(
             bins, label, weight, eval_bins, eval_label)
         trl = np.asarray(trl)
         evl = np.asarray(evl)
@@ -1018,7 +1023,7 @@ class GBDT:
         return ens, history
 
     @functools.lru_cache(maxsize=None)
-    def _staged_losses_fn(self):
+    def _staged_losses_fn(self, metric: str = "loss"):
         import jax
         import jax.lax as lax
         import jax.numpy as jnp
@@ -1037,7 +1042,8 @@ class GBDT:
                                                          bins, d, miss_id),
                     tree, K > 1)
                 margin = margin + delta
-                return margin, _logloss(margin, label, p.objective)
+                return margin, _eval_metric_fn(metric, p.objective)(margin,
+                                                                    label)
 
             margin0 = jnp.full((B,) if K == 1 else (B, K), p.base_score,
                                jnp.float32)
@@ -1087,15 +1093,17 @@ class GBDT:
         return np.asarray(self._predict_leaf_fn()(ensemble,
                                                   jnp.asarray(bins)))
 
-    def staged_losses(self, ensemble: TreeEnsemble, bins, label) -> np.ndarray:
-        """Per-round cumulative loss of the ensemble on any dataset — the
-        learning curve, post-hoc, as one compiled scan over the tree axis
-        (logloss / mlogloss / MSE per the objective).  [num_trees] f32."""
+    def staged_losses(self, ensemble: TreeEnsemble, bins, label,
+                      metric: str = "loss") -> np.ndarray:
+        """Per-round cumulative metric of the ensemble on any dataset —
+        the learning curve, post-hoc, as one compiled scan over the tree
+        axis.  ``metric``: loss (objective's own) | error | rmse | mae.
+        [num_trees] f32."""
         import jax.numpy as jnp
 
         if self.param.objective == "softmax":
             _check_softmax_labels(label, self.param.num_class)
-        return np.asarray(self._staged_losses_fn()(
+        return np.asarray(self._staged_losses_fn(metric)(
             ensemble, jnp.asarray(bins), jnp.asarray(label, jnp.float32)))
 
     # -- introspection / persistence ------------------------------------------
@@ -1313,6 +1321,37 @@ class _EarlyStop:
             self.best_loss, self.best_round = loss, r
             return False
         return bool(self.patience) and r - self.best_round >= self.patience
+
+
+def _eval_metric_fn(metric: str, objective: str):
+    """In-graph eval metric for fit_with_eval: 'loss' = the objective's
+    own loss (logloss/mlogloss/MSE), 'error' = classification error rate
+    (0.5 threshold / argmax), 'rmse' / 'mae' = regression errors.  All
+    are minimized by early stopping."""
+    import jax.numpy as jnp
+
+    if metric == "loss":
+        return lambda m, y: _logloss(m, y, objective)
+    if metric == "error":
+        CHECK(objective in ("logistic", "softmax"),
+              f"eval_metric='error' needs a classification objective, "
+              f"got {objective!r}")
+        if objective == "softmax":
+            return lambda m, y: jnp.mean(
+                (jnp.argmax(m, axis=1) != y.astype(jnp.int32)).astype(
+                    jnp.float32))
+        return lambda m, y: jnp.mean(((m > 0) != (y > 0.5)).astype(
+            jnp.float32))
+    if metric in ("rmse", "mae"):
+        CHECK(objective == "squared",
+              f"eval_metric={metric!r} compares margins to targets "
+              f"directly — only meaningful for objective='squared', got "
+              f"{objective!r} (classification margins are log-odds)")
+        if metric == "rmse":
+            return lambda m, y: jnp.sqrt(jnp.mean((m - y) ** 2))
+        return lambda m, y: jnp.mean(jnp.abs(m - y))
+    CHECK(False, f"unknown eval_metric {metric!r}; "
+                 f"use loss|error|rmse|mae")
 
 
 def _logloss(margin, label, objective: str):

@@ -74,7 +74,8 @@ class _GBDTEstimator:
         return GBDT(param, num_feature=X.shape[1])
 
     def fit(self, X, y, sample_weight=None, eval_set=None,
-            early_stopping_rounds: int = 0, comm=None):
+            early_stopping_rounds: int = 0, eval_metric: str = "loss",
+            comm=None):
         """Train; ``eval_set=(X_val, y_val)`` (or XGBoost-style
         ``[(X_val, y_val)]``) enables loss tracking and, with
         ``early_stopping_rounds``, best-round truncation.  ``comm``
@@ -111,13 +112,17 @@ class _GBDTEstimator:
             ev_bins, ev_y = binned[-1]
             self.ensemble_, self.eval_history_ = self.model_.fit_with_eval(
                 bins, yy, ev_bins, ev_y, weight=sample_weight,
-                early_stopping_rounds=early_stopping_rounds)
+                early_stopping_rounds=early_stopping_rounds,
+                eval_metric=eval_metric)
             # per-round curves for the remaining sets, post-hoc (one
             # compiled scan each).  NOTE: computed from the FINAL (possibly
             # early-stop-truncated) ensemble, so history entries past the
             # kept rounds carry only the primary set's eval_loss
             for i, (bv, lv) in enumerate(binned[:-1]):
-                curve = self.model_.staged_losses(self.ensemble_, bv, lv)
+                # same metric as the primary set: the curves must be
+                # comparable within one history dict
+                curve = self.model_.staged_losses(self.ensemble_, bv, lv,
+                                                  metric=eval_metric)
                 for r, entry in enumerate(self.eval_history_):
                     if r < len(curve):
                         entry[f"eval{i}_loss"] = float(curve[r])

@@ -804,3 +804,41 @@ def test_colsample_bynode():
     assert (np.asarray(ens2.split_feat) >= 0).any()
     acc2 = float(((np.asarray(m2_margin) > 0) == y).mean())
     assert acc2 > 0.6, acc2
+
+
+def test_eval_metric_error_and_rmse():
+    rng = np.random.RandomState(28)
+    x = rng.randn(2000, 4).astype(np.float32)
+    y = (x[:, 0] + 0.3 * rng.randn(2000) > 0).astype(np.float32)
+    m = GBDT(GBDTParam(num_boost_round=8, max_depth=3, num_bins=16,
+                       learning_rate=0.5), num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x), np.int32)
+    tr, ev, ytr, yev = bins[:1500], bins[1500:], y[:1500], y[1500:]
+    # error metric: history tracks error RATE, and both paths agree
+    for compiled in (True, False):
+        _, hist = m.fit_with_eval(tr, ytr, ev, yev, eval_metric="error",
+                                  compiled=compiled)
+        assert 0.0 <= hist[-1]["eval_loss"] <= 1.0
+        assert hist[-1]["eval_loss"] < 0.3
+    h_c = m.fit_with_eval(tr, ytr, ev, yev, eval_metric="error")[1]
+    h_h = m.fit_with_eval(tr, ytr, ev, yev, eval_metric="error",
+                          compiled=False)[1]
+    for a, b in zip(h_c, h_h):
+        assert abs(a["eval_loss"] - b["eval_loss"]) < 1e-6
+    # rmse on a regression objective
+    yr = (x[:, 0] * 2).astype(np.float32)
+    mr = GBDT(GBDTParam(num_boost_round=5, max_depth=3, num_bins=16,
+                        objective="squared"), num_feature=4)
+    mr.make_bins(x)
+    br = np.asarray(mr.bin_features(x), np.int32)
+    _, hist_r = mr.fit_with_eval(br[:1500], yr[:1500], br[1500:], yr[1500:],
+                                 eval_metric="rmse")
+    assert hist_r[-1]["eval_loss"] < hist_r[0]["eval_loss"]
+    # bad metric / wrong objective rejected
+    with pytest.raises(Exception, match="unknown eval_metric"):
+        mr.fit_with_eval(br[:100], yr[:100], br[100:200], yr[100:200],
+                         eval_metric="auc")
+    with pytest.raises(Exception, match="classification"):
+        mr.fit_with_eval(br[:100], yr[:100], br[100:200], yr[100:200],
+                         eval_metric="error")

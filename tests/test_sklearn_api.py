@@ -246,3 +246,16 @@ def test_multiple_eval_sets():
     clf2.fit(x[:500], y[:500],
              eval_set=(x[500:700].tolist(), y[500:700].tolist()))
     assert "eval_loss" in clf2.eval_history_[0]
+
+
+def test_multi_eval_sets_share_metric():
+    x, y = _binary(n=2400, seed=15)
+    clf = GBDTClassifier(num_boost_round=5, max_depth=3, num_bins=16,
+                         learning_rate=0.5)
+    clf.fit(x[:1600], y[:1600],
+            eval_set=[(x[1600:2000], y[1600:2000]), (x[2000:], y[2000:])],
+            eval_metric="error")
+    h = clf.eval_history_[-1]
+    # both curves are ERROR RATES (comparable), not logloss vs error
+    assert 0.0 <= h["eval_loss"] <= 1.0
+    assert 0.0 <= h["eval0_loss"] <= 1.0
