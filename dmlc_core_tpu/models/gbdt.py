@@ -76,8 +76,8 @@ class GBDTParam(Parameter):
     max_delta_step = field(float, default=0.0, lower=0.0,
                            help="cap on |leaf weight| before shrinkage "
                                 "(XGBoost's imbalanced-logistic stabiliser; "
-                                "0 disables). Applied to leaf values only, "
-                                "not to gain scoring")
+                                "0 disables). Applied to leaf values AND "
+                                "to split gain scoring, matching XGBoost")
     seed = field(int, default=0, help="subsampling PRNG seed")
     monotone_constraints = field(str, default="",
                                  help="per-feature monotone directions, "
@@ -185,8 +185,9 @@ def _check_softmax_labels(label, num_class: int, what: str = "labels"):
     out-of-range ids silently clamp under jit (take_along_axis / one-hot),
     so they must be rejected before tracing."""
     host = np.asarray(label)
-    CHECK(host.size == 0
-          or (host.min() >= 0 and host.max() < num_class),
+    if host.size == 0:
+        return
+    CHECK(host.min() >= 0 and host.max() < num_class,
           f"softmax {what} must lie in [0, {num_class}); "
           f"got range [{host.min()}, {host.max()}]")
 
@@ -239,9 +240,10 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
     [lower, upper] weight interval, children of a constrained split split
     that interval at the clamped midpoint, and leaf weights clamp into
     their interval — together these guarantee monotonic predictions.
-    (Gains are scored on unclamped weights — a mild difference from
-    XGBoost's clamp-aware scoring that affects split choice, never the
-    monotonicity guarantee.)
+    (Gains are scored before the interval clamp — a mild difference from
+    XGBoost's interval-aware scoring that affects split choice, never the
+    monotonicity guarantee.  The ``max_delta_step`` clamp, by contrast,
+    DOES enter gain scoring, via ``_score``.)
     """
     import jax.numpy as jnp
 
@@ -274,20 +276,41 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
         HT = HL[..., -1:]
         lam = reg_lambda
 
-        GTa = _l1_threshold(GT, reg_alpha)
+        mds = max_delta_step
+
+        def _clamp_w(w):
+            return jnp.clip(w, -mds, mds) if mds > 0.0 else w
+
+        def _opt_w(Gv, Hv):
+            # the (possibly mds-clamped) optimum leaf weight — the ONE
+            # definition shared by gain scoring, monotone masking, and the
+            # monotone interval midpoints, so they can never desynchronize
+            return _clamp_w(-_l1_threshold(Gv, reg_alpha) / (Hv + lam))
 
         def _weights(GLv, HLv):
-            wl = -_l1_threshold(GLv, reg_alpha) / (HLv + lam)
-            wr = -_l1_threshold(GT - GLv, reg_alpha) / (HT - HLv + lam)
-            return wl, wr
+            return _opt_w(GLv, HLv), _opt_w(GT - GLv, HT - HLv)
+
+        def _score(Gv, Hv):
+            # -2x the leaf objective at the (possibly clamped) optimum
+            # weight; algebraically equal to ThresholdL1(G)^2/(H+lam)
+            # when max_delta_step leaves the weight unclamped, so split
+            # choices under the cap match XGBoost's CalcWeight-clamped
+            # CalcGain rather than ignoring the cap.  Known deviation:
+            # with reg_alpha>0 AND a binding cap, the alpha term here is
+            # -2a|w| (the self-consistent -2x objective) where XGBoost's
+            # CalcGain adds +a|w| — gains, and possibly argmax splits,
+            # differ from XGBoost in that corner
+            if mds == 0.0:
+                return _l1_threshold(Gv, reg_alpha) ** 2 / (Hv + lam)
+            w = _opt_w(Gv, Hv)
+            return (-(2.0 * Gv * w + (Hv + lam) * w * w)
+                    - 2.0 * reg_alpha * jnp.abs(w))
 
         def _gain(GLv, HLv):
             GRv = GT - GLv
             HRv = HT - HLv
-            GLa = _l1_threshold(GLv, reg_alpha)
-            GRa = _l1_threshold(GRv, reg_alpha)
-            gn = (GLa ** 2 / (HLv + lam) + GRa ** 2 / (HRv + lam)
-                  - GTa ** 2 / (HT + lam))               # [n, F, nbins]
+            gn = (_score(GLv, HLv) + _score(GRv, HRv)
+                  - _score(GT, HT))                      # [n, F, nbins]
             ok = (HLv >= min_child_weight) & (HRv >= min_child_weight)
             if monotone is not None:
                 wl, wr = _weights(GLv, HLv)
@@ -356,8 +379,8 @@ def _build_tree(bins, g, h, max_depth: int, num_bins: int, reg_lambda: float,
                 HLb = jnp.where(dl, _at_best(HL + H[..., miss_id:miss_id + 1]),
                                 HLb)
             GTn, HTn = GT[:, 0, 0], HT[:, 0, 0]
-            wl = -_l1_threshold(GLb, reg_alpha) / (HLb + lam)
-            wr = -_l1_threshold(GTn - GLb, reg_alpha) / (HTn - HLb + lam)
+            wl = _opt_w(GLb, HLb)
+            wr = _opt_w(GTn - GLb, HTn - HLb)
             wl = jnp.clip(wl, node_lo, node_hi)
             wr = jnp.clip(wr, node_lo, node_hi)
             mid = 0.5 * (wl + wr)

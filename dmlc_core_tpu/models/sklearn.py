@@ -34,24 +34,28 @@ class _GBDTEstimator:
     """Shared fit/predict plumbing; subclasses fix the objective."""
 
     def __init__(self, handle_missing: Optional[bool] = None,
-                 bin_sample_rows: int = 100_000, **params):
+                 bin_sample_rows: int = 100_000,
+                 importance_type: str = "gain", **params):
         for k in params:
             CHECK(k in _PARAM_KEYS,
                   f"unknown parameter {k!r}; settable: {_PARAM_KEYS}")
         self._params: Dict[str, Any] = dict(params)
         self.handle_missing = handle_missing   # None = auto (NaN in X)
         self.bin_sample_rows = bin_sample_rows
+        self.importance_type = importance_type
 
     # -- sklearn protocol -----------------------------------------------------
     def get_params(self, deep: bool = True) -> Dict[str, Any]:
         out = dict(self._params)
         out["handle_missing"] = self.handle_missing
         out["bin_sample_rows"] = self.bin_sample_rows
+        out["importance_type"] = self.importance_type
         return out
 
     def set_params(self, **params):
         for k, v in params.items():
-            if k in ("handle_missing", "bin_sample_rows"):
+            if k in ("handle_missing", "bin_sample_rows",
+                     "importance_type"):
                 setattr(self, k, v)
             else:
                 CHECK(k in _PARAM_KEYS, f"unknown parameter {k!r}")
@@ -151,9 +155,13 @@ class _GBDTEstimator:
 
     @property
     def feature_importances_(self) -> np.ndarray:
-        """Normalized total-gain importances (XGBoost sklearn default)."""
+        """Normalized importances of the estimator's ``importance_type``
+        (default ``'gain'`` = mean gain per split, matching the XGBoost
+        sklearn wrapper's default; any :meth:`GBDT.feature_importance`
+        kind is accepted)."""
         self._check_fitted()
-        imp = self.model_.feature_importance(self.ensemble_, "total_gain")
+        imp = self.model_.feature_importance(self.ensemble_,
+                                             self.importance_type)
         total = imp.sum()
         return imp / total if total > 0 else imp
 

@@ -7,12 +7,15 @@ Reimplements the reference tracker protocol (tracker/dmlc_tracker/tracker.py):
 - commands: ``start`` / ``recover`` / ``print`` / ``shutdown``
   (tracker.py:269-291);
 - batch rank assignment sorted by host (tracker.py:295-311) with
-  jobid -> rank recovery (decide_rank, tracker.py:73-78);
+  jobid -> rank recovery (``WorkerEntry.resolve_rank``; reference
+  tracker.py:73-78);
 - topology: binary tree + parent map (tracker.py:185-191) and the
   tree-sharing data-recovery ring (tracker.py:193-225), relabeled so ring
   order is rank order (get_link_map, tracker.py:227-252);
-- the connection-brokering loop that repeats until every rank reports all its
-  links connected (assign_rank, tracker.py:80-135).
+- the link-brokering rounds that repeat until every rank reports all its
+  links connected (``WorkerEntry.send_topology`` + ``broker_links``; same
+  wire sequence as reference tracker.py:80-135, restructured here as
+  topology push / brokering rounds / accept-registry bookkeeping).
 
 On TPU the data plane no longer consumes these links (XLA collectives do the
 reduction), but the tracker stays wire-compatible so existing Rabit clients
@@ -71,7 +74,9 @@ def _resolve_ip(host: str) -> str:
 
 
 class WorkerEntry:
-    """One connected worker (reference SlaveEntry)."""
+    """One connected worker: the handshake state plus the per-worker half of
+    the link-brokering conversation (wire-compatible with Rabit's client
+    side; message sequence documented on each method)."""
 
     def __init__(self, sock: socket.socket, addr):
         self.sock = FramedSocket(sock)
@@ -84,63 +89,96 @@ class WorkerEntry:
         self.world_size = self.sock.recvint()
         self.jobid = self.sock.recvstr()
         self.cmd = self.sock.recvstr()
-        self.wait_accept = 0
+        # inbound links this worker still expects peers to dial (it stays in
+        # the tracker's accept registry until this reaches zero)
+        self.pending_accepts = 0
+        # the worker's own listening port, reported at the end of brokering
         self.port: Optional[int] = None
 
-    def decide_rank(self, job_map: Dict[str, int]) -> int:
+    def resolve_rank(self, jobid_ranks: Dict[str, int]) -> int:
+        """Keep a self-reported rank, else restore a restarted worker's old
+        rank by job id, else -1 (rank to be assigned in host order)."""
         if self.rank >= 0:
             return self.rank
-        if self.jobid != "NULL" and self.jobid in job_map:
-            return job_map[self.jobid]
-        return -1
+        return jobid_ranks.get(self.jobid, -1) if self.jobid != "NULL" else -1
 
-    def assign_rank(self, rank: int, wait_conn: Dict[int, "WorkerEntry"],
-                    tree_map, parent_map, ring_map) -> List[int]:
+    def send_topology(self, rank: int, world: int, tree_links: List[int],
+                      parent: int, ring_prev: int, ring_next: int) -> set:
+        """Push the assigned rank and its neighborhood down the wire.
+
+        Wire order (fixed by the Rabit client): rank, parent, world size,
+        tree-degree, each tree neighbor, ring-prev, ring-next — the ring
+        slots carry -1 when absent or self-referential.  Returns the full
+        link set (tree + real ring hops) this worker must establish.
+        """
         self.rank = rank
-        nnset = set(tree_map[rank])
-        rprev, rnext = ring_map[rank]
         self.sock.sendint(rank)
-        self.sock.sendint(parent_map[rank])
-        self.sock.sendint(len(tree_map))
-        self.sock.sendint(len(nnset))
-        for r in nnset:
-            self.sock.sendint(r)
-        if rprev not in (-1, rank):
-            nnset.add(rprev)
-            self.sock.sendint(rprev)
-        else:
-            self.sock.sendint(-1)
-        if rnext not in (-1, rank):
-            nnset.add(rnext)
-            self.sock.sendint(rnext)
-        else:
-            self.sock.sendint(-1)
-        # broker connections until this worker has all links
+        self.sock.sendint(parent)
+        self.sock.sendint(world)
+        self.sock.sendint(len(tree_links))
+        for peer in tree_links:
+            self.sock.sendint(peer)
+        links = set(tree_links)
+        for hop in (ring_prev, ring_next):
+            if hop in (-1, rank):
+                self.sock.sendint(-1)
+            else:
+                self.sock.sendint(hop)
+                links.add(hop)
+        return links
+
+    def broker_links(self, links: set,
+                     accept_registry: Dict[int, "WorkerEntry"]) -> List[int]:
+        """Run brokering rounds until this worker's dial attempts all
+        succeed.
+
+        Each round: the worker reports which peers it already reached; the
+        tracker answers with the subset of its missing peers that are
+        listening right now (count, then host/port/rank triples) plus how
+        many peers are not yet dialable (the worker must accept those
+        inbound later).  A round that ends with connect errors repeats;
+        a clean round ends with the worker reporting its own listening
+        port.  Bookkeeping after a clean round: every peer this worker was
+        told to dial has one fewer inbound accept outstanding — peers that
+        reach zero are fully linked and leave ``accept_registry``; this
+        worker records its own outstanding inbound count.  Returns the
+        ranks that became fully linked.
+        """
         while True:
-            ngood = self.sock.recvint()
-            goodset = {self.sock.recvint() for _ in range(ngood)}
-            assert goodset.issubset(nnset), (goodset, nnset)
-            badset = nnset - goodset
-            conset = [r for r in badset if r in wait_conn]
-            self.sock.sendint(len(conset))
-            self.sock.sendint(len(badset) - len(conset))
-            for r in conset:
-                self.sock.sendstr(wait_conn[r].host)
-                self.sock.sendint(wait_conn[r].port)
-                self.sock.sendint(r)
-            nerr = self.sock.recvint()
-            if nerr != 0:
+            reached = {self.sock.recvint()
+                       for _ in range(self.sock.recvint())}
+            assert reached <= links, (reached, links)
+            missing = links - reached
+            dialable = [peer for peer in missing if peer in accept_registry]
+            self.sock.sendint(len(dialable))
+            self.sock.sendint(len(missing) - len(dialable))
+            for peer in dialable:
+                listener = accept_registry[peer]
+                self.sock.sendstr(listener.host)
+                self.sock.sendint(listener.port)
+                self.sock.sendint(peer)
+            dial_errors = self.sock.recvint()
+            if dial_errors != 0:
                 continue
             self.port = self.sock.recvint()
-            done = []
-            for r in conset:
-                wait_conn[r].wait_accept -= 1
-                if wait_conn[r].wait_accept == 0:
-                    done.append(r)
-            for r in done:
-                wait_conn.pop(r, None)
-            self.wait_accept = len(badset) - len(conset)
-            return done
+            fully_linked = []
+            for peer in dialable:
+                listener = accept_registry[peer]
+                listener.pending_accepts -= 1
+                if listener.pending_accepts == 0:
+                    fully_linked.append(peer)
+            for peer in fully_linked:
+                accept_registry.pop(peer, None)
+            self.pending_accepts = len(missing) - len(dialable)
+            return fully_linked
+
+    def assign_rank(self, rank: int,
+                    accept_registry: Dict[int, "WorkerEntry"],
+                    tree_map, parent_map, ring_map) -> List[int]:
+        ring_prev, ring_next = ring_map[rank]
+        links = self.send_topology(rank, len(tree_map), tree_map[rank],
+                                   parent_map[rank], ring_prev, ring_next)
+        return self.broker_links(links, accept_registry)
 
 
 def bind_free_port(host: str, port: int = 9091,
@@ -241,8 +279,8 @@ class RabitTracker:
     # -- accept loop (tracker.py:254-320) -------------------------------------
     def _accept_workers(self, n: int) -> None:
         shutdown: Dict[int, WorkerEntry] = {}
-        wait_conn: Dict[int, WorkerEntry] = {}
-        job_map: Dict[str, int] = {}
+        accept_registry: Dict[int, WorkerEntry] = {}
+        jobid_ranks: Dict[str, int] = {}
         pending: List[WorkerEntry] = []
         tree_map = None
         todo_nodes: List[int] = []
@@ -273,7 +311,7 @@ class RabitTracker:
                 assert s.world_size in (-1, n)
             if s.cmd == "recover":
                 assert s.rank >= 0
-            rank = s.decide_rank(job_map)
+            rank = s.resolve_rank(jobid_ranks)
             if rank == -1:
                 assert todo_nodes
                 pending.append(s)
@@ -282,11 +320,11 @@ class RabitTracker:
                     for p in pending:
                         rank = todo_nodes.pop(0)
                         if p.jobid != "NULL":
-                            job_map[p.jobid] = rank
-                        p.assign_rank(rank, wait_conn, tree_map, parent_map,
-                                      ring_map)
-                        if p.wait_accept > 0:
-                            wait_conn[rank] = p
+                            jobid_ranks[p.jobid] = rank
+                        p.assign_rank(rank, accept_registry, tree_map,
+                                      parent_map, ring_map)
+                        if p.pending_accepts > 0:
+                            accept_registry[rank] = p
                         logger.debug("%s from %s; assigned rank %d",
                                      p.cmd, p.host, p.rank)
                     pending = []
@@ -294,10 +332,11 @@ class RabitTracker:
                     logger.info("@tracker all of %d nodes started", n)
                     self.start_time = time.time()
             else:
-                s.assign_rank(rank, wait_conn, tree_map, parent_map, ring_map)
+                s.assign_rank(rank, accept_registry, tree_map, parent_map,
+                              ring_map)
                 logger.debug("%s signal from %d", s.cmd, s.rank)
-                if s.wait_accept > 0:
-                    wait_conn[rank] = s
+                if s.pending_accepts > 0:
+                    accept_registry[rank] = s
         self.end_time = time.time()
         logger.info("@tracker all nodes finished; %.3f secs between start and finish",
                     (self.end_time - (self.start_time or self.end_time)))

@@ -724,6 +724,55 @@ def test_max_delta_step_caps_leaves():
     assert leaves(0.0).max() > 0.7 * lr        # uncapped would exceed it
 
 
+def test_max_delta_step_enters_gain_scoring():
+    """The cap reshapes split gains (XGBoost's clamp-aware CalcGain), and
+    the clamped score reduces exactly to the closed form when the cap
+    never binds."""
+    rng = np.random.RandomState(25)
+    x = rng.randn(2000, 3).astype(np.float32)
+    y = (x[:, 0] > 2.0).astype(np.float32)     # imbalanced -> big weights
+
+    def fit(mds):
+        m = GBDT(GBDTParam(num_boost_round=3, max_depth=3, num_bins=16,
+                           max_delta_step=mds), num_feature=3)
+        m.make_bins(x)
+        ens, _ = m.fit_binned(m.bin_features(x), y)
+        return ens
+
+    e0, e_tight, e_loose = fit(0.0), fit(0.05), fit(1e6)
+    # a non-binding cap is a no-op on both splits and recorded gains
+    np.testing.assert_array_equal(np.asarray(e_loose.split_feat),
+                                  np.asarray(e0.split_feat))
+    np.testing.assert_allclose(np.asarray(e_loose.split_gain),
+                               np.asarray(e0.split_gain), rtol=1e-5)
+    # a binding cap changes the recorded gains (scored at clamped weights)
+    assert not np.allclose(np.asarray(e_tight.split_gain),
+                           np.asarray(e0.split_gain))
+
+
+def test_max_delta_step_composes_with_monotone():
+    """Monotone interval midpoints are built from mds-clamped weights, so
+    interval lower bounds can never push a leaf beyond the cap."""
+    rng = np.random.RandomState(7)
+    x = rng.randn(2000, 3).astype(np.float32)
+    y = (x[:, 0] + 0.2 * rng.randn(2000) > 1.8).astype(np.float32)
+    lr, mds = 0.5, 0.1
+    m = GBDT(GBDTParam(num_boost_round=3, max_depth=3, num_bins=16,
+                       learning_rate=lr, max_delta_step=mds,
+                       monotone_constraints="(1,0,0)"), num_feature=3)
+    m.make_bins(x)
+    ens, _ = m.fit_binned(m.bin_features(x), y)
+    assert np.abs(np.asarray(ens.leaf_value)).max() <= mds * lr + 1e-6
+
+
+def test_softmax_label_check_accepts_empty():
+    from dmlc_core_tpu.models.gbdt import _check_softmax_labels
+
+    _check_softmax_labels(np.array([]), 3)     # must not raise
+    with pytest.raises(Exception, match="must lie in"):
+        _check_softmax_labels(np.array([0, 3]), 3)
+
+
 def test_boost_round_requires_round_index_under_bylevel():
     m = GBDT(GBDTParam(colsample_bylevel=0.5, max_depth=2, num_bins=8),
              num_feature=4)

@@ -16,7 +16,8 @@ import time
 import pytest
 
 from dmlc_core_tpu.tracker.opts import get_opts, parse_memory_mb
-from dmlc_core_tpu.tracker.rendezvous import MAGIC, FramedSocket, RabitTracker
+from dmlc_core_tpu.tracker.rendezvous import (MAGIC, FramedSocket,
+                                              RabitTracker, WorkerEntry)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -178,6 +179,110 @@ def test_print_command(caplog):
         c.shutdown()
         tracker.join(timeout=10)
     assert any("hello tracker" in r.message for r in caplog.records)
+
+
+# --------------------------------------------- wire-protocol conformance ----
+def test_worker_entry_wire_transcript():
+    """Pin the exact brokering message sequence a Rabit client sees,
+    including the connect-error retry round (the tracker must re-serve the
+    dialable list) and the accept-registry bookkeeping afterwards."""
+    tracker_end, client_end = socket.socketpair()
+    results = {}
+
+    class _ListeningPeer:
+        # an earlier worker already registered as awaiting inbound dials
+        host, port, pending_accepts = "10.0.0.9", 7777, 1
+
+    def tracker_side():
+        entry = WorkerEntry(tracker_end, ("127.0.0.1", 0))
+        registry = {1: _ListeningPeer()}
+        links = entry.send_topology(rank=0, world=3, tree_links=[1, 2],
+                                    parent=-1, ring_prev=2, ring_next=1)
+        results["links"] = links
+        results["fully_linked"] = entry.broker_links(links, registry)
+        results["entry"] = entry
+        results["registry"] = registry
+
+    t = threading.Thread(target=tracker_side, daemon=True)
+    t.start()
+    fs = FramedSocket(client_end)
+    fs.sendint(MAGIC)
+    assert fs.recvint() == MAGIC
+    fs.sendint(-1)            # no self-reported rank
+    fs.sendint(3)             # world size
+    fs.sendstr("NULL")
+    fs.sendstr("start")
+    assert fs.recvint() == 0          # assigned rank
+    assert fs.recvint() == -1         # parent
+    assert fs.recvint() == 3          # world
+    assert fs.recvint() == 2          # tree degree
+    assert {fs.recvint(), fs.recvint()} == {1, 2}
+    assert fs.recvint() == 2          # ring prev
+    assert fs.recvint() == 1          # ring next
+
+    def recv_dialables():
+        n_dial = fs.recvint()
+        n_pending = fs.recvint()
+        triples = [(fs.recvstr(), fs.recvint(), fs.recvint())
+                   for _ in range(n_dial)]
+        return n_dial, n_pending, triples
+
+    # round 1: nothing reached yet; report a connect error to force a retry
+    fs.sendint(0)
+    n_dial, n_pending, triples = recv_dialables()
+    assert (n_dial, n_pending) == (1, 1)
+    assert triples == [("10.0.0.9", 7777, 1)]
+    fs.sendint(1)             # one dial failed -> tracker repeats the round
+    # round 2: still nothing reached; this time the dial succeeds
+    fs.sendint(0)
+    assert recv_dialables() == (1, 1, [("10.0.0.9", 7777, 1)])
+    fs.sendint(0)             # no errors
+    fs.sendint(5555)          # our own listening port
+    t.join(10)
+    assert not t.is_alive(), "broker_links did not return"
+    assert results["links"] == {1, 2}
+    assert results["fully_linked"] == [1]     # peer 1 drained its accepts
+    assert 1 not in results["registry"]
+    entry = results["entry"]
+    assert entry.port == 5555
+    assert entry.pending_accepts == 1         # peer 2 will dial us later
+    tracker_end.close()
+    client_end.close()
+
+
+@pytest.mark.parametrize("n", [2, 4, 7])
+def test_rendezvous_realizes_every_link(n):
+    """After rendezvous every tree+ring edge exists as exactly one TCP
+    connection (one side dialed, the other accepted)."""
+    tree_map, parent_map, ring_map = RabitTracker.get_link_map(n)
+    edges = set()
+    for r in range(n):
+        for p in tree_map[r]:
+            edges.add(frozenset((r, p)))
+        for p in ring_map[r]:
+            if p not in (-1, r):
+                edges.add(frozenset((r, p)))
+    tracker = RabitTracker("127.0.0.1", n)
+    tracker.start(n)
+    clients = [FakeRabitClient("127.0.0.1", tracker.port) for _ in range(n)]
+    threads = [threading.Thread(target=c.start, daemon=True) for c in clients]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=20)
+        assert not th.is_alive(), "rendezvous deadlocked"
+    # each edge contributes one socket at each endpoint; acceptors run in
+    # background threads, so poll for the expected global count
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        total = sum(len(c.peer_socks) for c in clients)
+        if total == 2 * len(edges):
+            break
+        time.sleep(0.05)
+    assert total == 2 * len(edges), (total, 2 * len(edges))
+    for c in clients:
+        c.shutdown()
+    tracker.join(timeout=20)
 
 
 # ------------------------------------------------------------------ opts ----
