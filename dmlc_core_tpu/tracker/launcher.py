@@ -1,7 +1,8 @@
 """Container-side bootstrap (reference tracker/dmlc_tracker/launcher.py).
 
 Prepares the environment inside a freshly-scheduled container and execs the
-worker command: unpacks job archives (``DMLC_JOB_ARCHIVES``), assembles
+worker command: copies job files (``DMLC_JOB_FILES``) and unpacks job
+archives (``DMLC_JOB_ARCHIVES``) into the task cwd, assembles
 ``LD_LIBRARY_PATH``/``PYTHONPATH``, infers the role on SGE, then replaces
 itself with the command.
 """
@@ -9,23 +10,45 @@ itself with the command.
 from __future__ import annotations
 
 import os
+import shutil
 import subprocess
 import sys
-import zipfile
+import tempfile
 
 __all__ = ["main"]
 
 
+def materialize_files(spec: str) -> None:
+    """Copy '#'-renamable files listed in DMLC_JOB_FILES into the cwd
+    (sources must be container-visible, e.g. on a shared filesystem).
+    Copies land via a temp file + atomic replace so concurrent tasks in a
+    shared cwd never see a half-written file."""
+    for item in spec.split(":"):
+        if not item:
+            continue
+        src, _, dest = item.partition("#")
+        dest = dest or os.path.basename(src)
+        if os.path.exists(src) and not os.path.exists(dest):
+            fd, tmp = tempfile.mkstemp(prefix=".dmlc-file-",
+                                       dir=os.path.dirname(dest) or ".")
+            os.close(fd)
+            shutil.copy2(src, tmp)
+            os.replace(tmp, dest)
+
+
 def unpack_archives(spec: str) -> None:
-    """Unzip '#'-renamable archives listed in DMLC_JOB_ARCHIVES."""
+    """Unzip '#'-renamable archives listed in DMLC_JOB_ARCHIVES
+    (atomic-rename extraction: safe under concurrent tasks sharing a
+    cwd, e.g. SGE array jobs)."""
+    from dmlc_core_tpu.tracker.filecache import extract_archive_atomic
+
     for item in spec.split(":"):
         if not item:
             continue
         src, _, dest = item.partition("#")
         dest = dest or os.path.splitext(os.path.basename(src))[0]
-        if os.path.exists(src) and not os.path.exists(dest):
-            with zipfile.ZipFile(src) as zf:
-                zf.extractall(dest)
+        if os.path.exists(src):
+            extract_archive_atomic(src, dest)
 
 
 def main(argv=None) -> int:
@@ -35,6 +58,10 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     env = os.environ
+    cwd = env.get("DMLC_JOB_CWD")
+    if cwd:
+        os.chdir(cwd)
+    materialize_files(env.get("DMLC_JOB_FILES", ""))
     unpack_archives(env.get("DMLC_JOB_ARCHIVES", ""))
     # library paths
     extra_lib = [p for p in (env.get("DMLC_HDFS_OPTS", ""),) if p]
@@ -48,9 +75,6 @@ def main(argv=None) -> int:
     # role inference on SGE array jobs (reference launcher.py)
     if "SGE_TASK_ID" in env and "DMLC_TASK_ID" not in env:
         env["DMLC_TASK_ID"] = str(int(env["SGE_TASK_ID"]) - 1)
-    cwd = env.get("DMLC_JOB_CWD")
-    if cwd:
-        os.chdir(cwd)
     return subprocess.call(argv, env=env)
 
 

@@ -9,9 +9,13 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
+import tempfile
 import threading
-from typing import Dict, List
+from typing import Dict, List, Optional
 
+import shutil
+
+from dmlc_core_tpu.tracker.filecache import prepare_shipping, stage_job_dir
 from dmlc_core_tpu.tracker.submit import submit_job
 
 __all__ = ["submit", "exec_cmd"]
@@ -20,12 +24,13 @@ logger = logging.getLogger("dmlc_core_tpu.tracker")
 
 
 def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
-             num_attempt: int = 1) -> None:
+             num_attempt: int = 1, cwd: Optional[str] = None) -> None:
     """Run one task with retry (reference local.py:25-40).
 
     ``num_attempt`` is the total attempt budget; like the reference, the
     ``DMLC_NUM_ATTEMPT`` env var is exported once (the configured budget)
-    and never mutated across retries.
+    and never mutated across retries.  ``cwd`` is the staged job dir when
+    the submit shipped files (the local stand-in for a container sandbox).
     """
     env = os.environ.copy()
     env.update(pass_env)
@@ -34,7 +39,7 @@ def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
     env["DMLC_NUM_ATTEMPT"] = str(num_attempt)
     num_retry = num_attempt
     while True:
-        ret = subprocess.call(cmd, env=env)
+        ret = subprocess.call(cmd, env=env, cwd=cwd)
         if ret == 0:
             logger.debug("task %s:%d finished", role, taskid)
             return
@@ -45,14 +50,28 @@ def exec_cmd(cmd: List[str], role: str, taskid: int, pass_env: Dict[str, str],
 
 
 def submit(opts) -> None:
+    # file shipping: only when the job names files/archives explicitly —
+    # a bare local run keeps its cwd and command untouched (no surprise
+    # directory changes for jobs that never opted into shipping)
+    ship_env, command, files, archives = prepare_shipping(opts)
+    job_dir = None
+    if files or archives:
+        job_dir = tempfile.mkdtemp(prefix="dmlc-job-")
+        stage_job_dir(files, archives, job_dir)
+        ship_env["DMLC_JOB_CWD"] = job_dir
+        logger.info("staged %d files / %d archives into %s",
+                    len(files), len(archives), job_dir)
+
     def fun_submit(envs: Dict[str, str]) -> None:
+        envs = {**envs, **ship_env}
         threads = []
         errors: List[BaseException] = []
 
         def run(role: str, taskid: int) -> None:
             try:
-                exec_cmd(opts.command, role, taskid, envs,
-                         num_attempt=getattr(opts, "num_attempt", 1))
+                exec_cmd(command, role, taskid, envs,
+                         num_attempt=getattr(opts, "num_attempt", 1),
+                         cwd=job_dir)
             except BaseException as exc:  # noqa: BLE001
                 errors.append(exc)
 
@@ -64,9 +83,13 @@ def submit(opts) -> None:
             t = threading.Thread(target=run, args=("worker", i), daemon=True)
             t.start()
             threads.append(t)
-        for t in threads:
-            t.join()
-        if errors:
-            raise errors[0]
+        try:
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+        finally:
+            if job_dir is not None:
+                shutil.rmtree(job_dir, ignore_errors=True)
 
     submit_job(opts, fun_submit, wait=False)

@@ -94,8 +94,19 @@ def _run_task(master: str, prog: str, env: Dict[str, str],
 def submit(opts) -> None:
     master = _resolve_master(opts)
 
+    # file shipping: wrap the task in the launcher, which materializes
+    # DMLC_JOB_FILES / unpacks DMLC_JOB_ARCHIVES into the task cwd
+    # (sources must be agent-visible, e.g. shared FS).  always=True:
+    # containers get a fresh sandbox, so auto-file-cache applies without
+    # explicit --files, like the reference's YARN semantics.
+    from dmlc_core_tpu.tracker.filecache import prepare_shipping
+
+    ship_env, command, _, _ = prepare_shipping(opts, wrap_launcher=True,
+                                               always=True)
+
     def fun_submit(envs: Dict[str, str]) -> None:
-        prog = " ".join(opts.command)
+        envs = {**envs, **ship_env}
+        prog = " ".join(command)
         threads = []
         errors: List[BaseException] = []
 

@@ -56,6 +56,21 @@ def get_opts(args=None) -> argparse.Namespace:
     parser.add_argument("--num-attempt", type=int,
                         default=int(os.environ.get("DMLC_NUM_ATTEMPT", "1")),
                         help="per-worker retry attempts (local backend)")
+    parser.add_argument("--files", action="append", default=[],
+                        help="file (src or src#dest) copied to the task "
+                             "execution dir; repeatable (reference "
+                             "opts.py:108-113)")
+    parser.add_argument("--archives", action="append", default=[],
+                        help="zip archive (src or src#dest) unpacked in the "
+                             "task execution dir; repeatable — ship python "
+                             "libs this way (reference opts.py:114-120)")
+    parser.add_argument("--auto-file-cache",
+                        action=argparse.BooleanOptionalAction, default=True,
+                        help="cache command-line tokens that name existing "
+                             "files and rewrite them to ./basename "
+                             "(reference opts.py:6-36); applies when the "
+                             "backend stages a job dir (--files/--archives "
+                             "given, or yarn/mesos)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command to run")
     opts = parser.parse_args(args)

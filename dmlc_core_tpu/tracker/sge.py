@@ -21,7 +21,16 @@ logger = logging.getLogger("dmlc_core_tpu.tracker")
 
 
 def submit(opts) -> None:
+    # file shipping (opt-in via --files/--archives: qsub -cwd already runs
+    # tasks in the shared-FS submit dir): wrap the task in the launcher,
+    # which materializes DMLC_JOB_FILES / unpacks DMLC_JOB_ARCHIVES into
+    # the task cwd
+    from dmlc_core_tpu.tracker.filecache import prepare_shipping
+
+    ship_env, command, _, _ = prepare_shipping(opts, wrap_launcher=True)
+
     def fun_submit(envs: Dict[str, str]) -> None:
+        envs = {**envs, **ship_env}
         runscript = os.path.join(os.getcwd(), f"{opts.jobname}.sge.sh")
         with open(runscript, "w") as f:
             f.write("#!/bin/bash\n#$ -S /bin/bash\n")
@@ -39,7 +48,7 @@ def submit(opts) -> None:
                     '  export DMLC_ROLE=worker\n'
                     '  export DMLC_TASK_ID=$((GLOBAL_ID - %d))\nfi\n'
                     % (opts.num_servers, opts.num_servers))
-            f.write(" ".join(map(_shquote, opts.command)) + "\n")
+            f.write(" ".join(map(_shquote, command)) + "\n")
         os.chmod(runscript, os.stat(runscript).st_mode | stat.S_IEXEC)
         n = opts.num_workers + opts.num_servers
         cmd = ["qsub", "-cwd", "-t", f"1-{n}",

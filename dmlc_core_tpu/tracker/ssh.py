@@ -52,12 +52,62 @@ def sync_dir(local_dir: str, host: str, port: int, remote_dir: str) -> None:
     subprocess.check_call(cmd)
 
 
+def ship_files(specs: List[str], host: str, port: int,
+               remote_dir: str) -> None:
+    """scp the job's cached ``src#dest`` entries into the remote workdir
+    under their dest names (the ssh-backend leg of the --files/--archives
+    contract)."""
+    from dmlc_core_tpu.tracker.filecache import split_spec_item
+
+    for item in specs:
+        src, dest = split_spec_item(item)
+        cmd = ["scp", "-o", "StrictHostKeyChecking=no", "-P", str(port),
+               src, f"{host}:{remote_dir}/{dest}"]
+        logger.debug("scp: %s", " ".join(cmd))
+        subprocess.check_call(cmd)
+
+
+# remote one-liner: extract into a temp dir, rename into place — dest only
+# ever appears fully extracted, and concurrent workers on one host race
+# safely (same dance as filecache.extract_archive_atomic)
+_REMOTE_UNZIP = (
+    "import os,shutil,sys,tempfile,zipfile\n"
+    "src, dest = sys.argv[1:3]\n"
+    "if not os.path.exists(dest):\n"
+    "    tmp = tempfile.mkdtemp(prefix='.dmlc-unpack-', dir='.')\n"
+    "    try:\n"
+    "        zipfile.ZipFile(src).extractall(tmp)\n"
+    "        os.rename(tmp, dest)\n"
+    "    except OSError:\n"
+    "        shutil.rmtree(tmp, ignore_errors=True)\n"
+    "        if not os.path.exists(dest):\n"
+    "            raise\n")
+
+
+def _unpack_prelude(archives: List[str]) -> str:
+    """Remote shell prelude unpacking shipped archives with a stdlib-only
+    python one-liner (no framework install needed on the remote side);
+    dest naming matches the launcher's src#dest rule."""
+    from dmlc_core_tpu.tracker.filecache import split_spec_item
+
+    steps = []
+    for item in archives:
+        src, dest = split_spec_item(item, archive=True)
+        # the zip was shipped under its basename into the workdir
+        steps.append(f"python -c {_shquote(_REMOTE_UNZIP)} "
+                     f"{_shquote(os.path.basename(src))} {_shquote(dest)}")
+    return "; ".join(steps)
+
+
 def _ssh_command(host: str, port: int, env: Dict[str, str], workdir: str,
-                 cmd: List[str]) -> List[str]:
+                 cmd: List[str], prelude: str = "") -> List[str]:
     exports = "; ".join(f"export {k}={_shquote(v)}" for k, v in env.items())
-    remote = f"{exports}; cd {_shquote(workdir)}; exec {' '.join(map(_shquote, cmd))}"
+    steps = [exports, f"cd {_shquote(workdir)}"]
+    if prelude:
+        steps.append(prelude)
+    steps.append(f"exec {' '.join(map(_shquote, cmd))}")
     return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(port), host,
-            remote]
+            "; ".join(steps)]
 
 
 def _shquote(s: str) -> str:
@@ -70,11 +120,26 @@ def submit(opts) -> None:
     assert opts.host_file, "--host-file is required for the ssh backend"
     hosts = parse_host_file(opts.host_file, opts.ssh_port)
 
+    # file shipping: cached files + archives ride next to the rsync; the
+    # command is rewritten to ./basename only when shipping is active
+    from dmlc_core_tpu.tracker.filecache import (prepare_shipping,
+                                                 split_spec_item)
+
+    _, command, shipped, archives = prepare_shipping(opts)
+    # the archive zips themselves travel by scp under their basenames
+    shipped = shipped + [
+        f"{split_spec_item(a, archive=True)[0]}"
+        f"#{os.path.basename(split_spec_item(a, archive=True)[0])}"
+        for a in archives]
+    prelude = _unpack_prelude(archives)
+
     def fun_submit(envs: Dict[str, str]) -> None:
         workdir = opts.sync_dst_dir or os.getcwd()
         if opts.sync_dst_dir:
             for host, port in set(hosts):
                 sync_dir(os.getcwd(), host, port, opts.sync_dst_dir)
+        for host, port in set(hosts):
+            ship_files(shipped, host, port, workdir)
         threads = []
         for i in range(opts.num_workers + opts.num_servers):
             role = "server" if i < opts.num_servers else "worker"
@@ -86,7 +151,8 @@ def submit(opts) -> None:
             for key in FORWARD_ENV:
                 if key in os.environ:
                     env.setdefault(key, os.environ[key])
-            cmd = _ssh_command(host, port, env, workdir, opts.command)
+            cmd = _ssh_command(host, port, env, workdir, command,
+                               prelude=prelude)
             t = threading.Thread(target=subprocess.check_call, args=(cmd,),
                                  daemon=True)
             t.start()

@@ -285,8 +285,18 @@ def submit(opts) -> None:
     CHECK(rm_uri, "yarn backend needs YARN_RM_URI (ResourceManager REST "
                   "endpoint, e.g. http://rm:8088)")
 
+    # file shipping: every task command already routes through the
+    # container-side launcher, which materializes DMLC_JOB_FILES and
+    # unpacks DMLC_JOB_ARCHIVES into the task cwd (sources must be
+    # container-visible, e.g. shared FS — the REST adapter has no
+    # HDFS-localized-resource path).  always=True: like the reference's
+    # YARN backend, auto-file-cache applies without explicit --files.
+    from dmlc_core_tpu.tracker.filecache import prepare_shipping
+
+    ship_env, opts.command, _, _ = prepare_shipping(opts, always=True)
+
     def fun_submit(envs: Dict[str, str]) -> None:
-        cluster = RestYarnCluster(rm_uri, opts, envs)
+        cluster = RestYarnCluster(rm_uri, opts, {**envs, **ship_env})
         try:
             sup = supervise(cluster, opts.num_workers, opts.num_servers)
             logger.info("yarn job %s finished: %d tasks ok", opts.jobname,

@@ -1,0 +1,228 @@
+"""Job file-cache tests: collect/rewrite semantics, staging, launcher
+materialization, ssh command construction, and a local-backend e2e job that
+ships a file + an archive and reads both from the worker cwd (VERDICT
+round-3 item 4; reference semantics tracker/dmlc_tracker/opts.py:6-36,
+108-126)."""
+
+import argparse
+import os
+import stat
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+from dmlc_core_tpu.tracker.filecache import (collect_job_files, files_env,
+                                             split_spec_item, stage_job_dir)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _opts(**kw):
+    ns = argparse.Namespace(command=[], files=[], archives=[],
+                            auto_file_cache=True)
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_split_spec_item():
+    assert split_spec_item("/a/b/data.txt") == ("/a/b/data.txt", "data.txt")
+    assert split_spec_item("/a/lib.zip", archive=True) == ("/a/lib.zip", "lib")
+    assert split_spec_item("/a/lib.zip#pylib", archive=True) == \
+        ("/a/lib.zip", "pylib")
+
+
+def test_collect_auto_cache_rewrites_tokens(tmp_path, monkeypatch):
+    exe = tmp_path / "kmeans"
+    exe.write_text("#!/bin/sh\necho hi\n")
+    conf = tmp_path / "kmeans.conf"
+    conf.write_text("k=3\n")
+    monkeypatch.chdir(tmp_path)
+    opts = _opts(command=["../" + tmp_path.name + "/kmeans",
+                          "kmeans.conf", "--niter", "10"])
+    files, archives, command = collect_job_files(opts)
+    assert command == ["./kmeans", "./kmeans.conf", "--niter", "10"]
+    assert files == [f"{exe}#kmeans", f"{conf}#kmeans.conf"]
+    assert archives == []
+
+
+def test_collect_no_auto_cache(tmp_path):
+    conf = tmp_path / "c.conf"
+    conf.write_text("x\n")
+    opts = _opts(command=[str(conf)], auto_file_cache=False,
+                 files=[str(conf)])
+    files, _, command = collect_job_files(opts)
+    assert command == [str(conf)]          # token untouched
+    assert files == [f"{conf}#c.conf"]     # but --files still ships it
+
+
+def test_collect_files_rename_preserved(tmp_path):
+    src = tmp_path / "cfg.prod"
+    src.write_text("x\n")
+    opts = _opts(files=[f"{src}#config.txt"])
+    files, _, _ = collect_job_files(opts)
+    assert files == [f"{src}#config.txt"]
+    dest = tmp_path / "jobdir"
+    stage_job_dir(files, [], str(dest))
+    assert (dest / "config.txt").read_text() == "x\n"
+    assert not (dest / "cfg.prod").exists()
+
+
+def test_collect_missing_files_warn_and_skip(tmp_path, caplog):
+    opts = _opts(files=[str(tmp_path / "nope")],
+                 archives=[str(tmp_path / "nope.zip")])
+    files, archives, _ = collect_job_files(opts)
+    assert files == [] and archives == []
+
+
+def test_stage_preserves_exec_bit_and_unpacks(tmp_path):
+    exe = tmp_path / "tool"
+    exe.write_text("#!/bin/sh\necho ok\n")
+    exe.chmod(0o755)
+    ar = tmp_path / "lib.zip"
+    with zipfile.ZipFile(ar, "w") as zf:
+        zf.writestr("pkg/__init__.py", "VALUE = 7\n")
+    dest = tmp_path / "jobdir"
+    stage_job_dir([f"{exe}#tool"], [f"{ar}#mylib"], str(dest))
+    staged = dest / "tool"
+    assert staged.exists()
+    assert staged.stat().st_mode & stat.S_IXUSR
+    assert (dest / "mylib" / "pkg" / "__init__.py").read_text() == \
+        "VALUE = 7\n"
+
+
+def test_files_env_contract(tmp_path):
+    env = files_env(["/x/a.txt#a.txt", "/y/b.bin#bb.bin"], ["/z/l.zip#lib"])
+    assert env["DMLC_JOB_FILES"] == "/x/a.txt#a.txt:/y/b.bin#bb.bin"
+    assert env["DMLC_JOB_ARCHIVES"] == "/z/l.zip#lib"
+    assert files_env([], []) == {}
+
+
+def test_prepare_shipping_gates(tmp_path):
+    from dmlc_core_tpu.tracker.filecache import prepare_shipping
+
+    script = tmp_path / "job.py"
+    script.write_text("pass\n")
+    bare = _opts(command=["python", str(script)])
+    # opt-in backends: inactive without --files/--archives
+    env, cmd, files, ar = prepare_shipping(bare)
+    assert (env, files, ar) == ({}, [], []) and cmd == bare.command
+    # sandbox backends (always=True): auto-cache kicks in by default...
+    env, cmd, files, ar = prepare_shipping(bare, always=True,
+                                           wrap_launcher=True)
+    assert files == [f"{script}#job.py"]
+    assert cmd[:3] == ["python", "-m", "dmlc_core_tpu.tracker.launcher"]
+    assert cmd[3:] == ["python", "./job.py"]
+    assert env["DMLC_JOB_FILES"] == f"{script}#job.py"
+    # ...but respects --no-auto-file-cache
+    off = _opts(command=["python", str(script)], auto_file_cache=False)
+    env, cmd, files, ar = prepare_shipping(off, always=True)
+    assert (env, files, ar) == ({}, [], []) and cmd == off.command
+
+
+def test_extract_archive_atomic_concurrent(tmp_path):
+    import threading
+
+    from dmlc_core_tpu.tracker.filecache import extract_archive_atomic
+
+    ar = tmp_path / "big.zip"
+    with zipfile.ZipFile(ar, "w") as zf:
+        for i in range(50):
+            zf.writestr(f"d/f{i}.txt", "x" * 1000)
+    dest = tmp_path / "out"
+    errs = []
+
+    def go():
+        try:
+            extract_archive_atomic(str(ar), str(dest))
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=go) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(list((dest / "d").iterdir())) == 50
+    # no leftover temp dirs
+    assert [p for p in tmp_path.iterdir()
+            if p.name.startswith(".dmlc-unpack-")] == []
+
+
+def test_launcher_materializes_files(tmp_path, monkeypatch):
+    from dmlc_core_tpu.tracker.launcher import materialize_files
+
+    src = tmp_path / "src" / "model.bin"
+    src.parent.mkdir()
+    src.write_bytes(b"\x01\x02")
+    monkeypatch.chdir(tmp_path)
+    materialize_files(f"{src}#model.bin:{tmp_path}/absent#a")
+    assert (tmp_path / "model.bin").read_bytes() == b"\x01\x02"
+    assert not (tmp_path / "a").exists()
+
+
+def test_ssh_ship_command_construction(tmp_path):
+    from dmlc_core_tpu.tracker.ssh import _ssh_command, _unpack_prelude
+
+    prelude = _unpack_prelude([f"{tmp_path}/lib.zip#pylib"])
+    assert "lib.zip pylib" in prelude
+    assert "extractall" in prelude          # atomic unzip one-liner
+    cmd = _ssh_command("h1", 22, {"A": "1"}, "/work", ["./run"],
+                       prelude=prelude)
+    remote = cmd[-1]
+    assert remote.index("cd /work") < remote.index("extractall") < \
+        remote.index("exec ./run")
+
+
+def test_local_backend_ships_files_e2e(tmp_path):
+    """dmlc-submit --cluster local with --files/--archives + auto-cache:
+    the worker script itself is auto-cached, and reads the shipped data
+    file and unpacked archive from its own cwd (the staged job dir)."""
+    data = tmp_path / "shipped.txt"
+    data.write_text("payload-42\n")
+    ar = tmp_path / "bundle.zip"
+    with zipfile.ZipFile(ar, "w") as zf:
+        zf.writestr("inner.txt", "from-archive\n")
+    worker = tmp_path / "worker.py"
+    out = tmp_path / "out.txt"
+    worker.write_text(
+        "import os\n"
+        f"out = open({str(out)!r}, 'a')\n"
+        "print(os.getcwd(), open('shipped.txt').read().strip(),\n"
+        "      open(os.path.join('bundle', 'inner.txt')).read().strip(),\n"
+        "      file=out)\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+         "--cluster", "local", "--num-workers", "2",
+         "--files", str(data), "--archives", str(ar), "--",
+         sys.executable, str(worker)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 2
+    for line in lines:
+        cwd, shipped, inner = line.split()
+        assert os.path.basename(cwd).startswith("dmlc-job-")
+        assert shipped == "payload-42"
+        assert inner == "from-archive"
+
+
+def test_local_backend_without_files_keeps_cwd(tmp_path):
+    """No --files/--archives: the worker runs in the submit cwd with an
+    untouched command (no surprise staging for existing jobs)."""
+    worker = tmp_path / "w.py"
+    worker.write_text("import os; print('CWD=' + os.getcwd())\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+         "--cluster", "local", "--num-workers", "1", "--",
+         sys.executable, str(worker)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert f"CWD={tmp_path}" in proc.stdout
