@@ -55,7 +55,7 @@ def _load() -> Optional[ctypes.CDLL]:
         # ABI handshake: a stale build with old entry-point signatures must
         # not be called through mismatched ctypes prototypes — rebuild once,
         # and disable the native path if the rebuild still disagrees
-        _ABI = 2
+        _ABI = 3
         ver_fn = getattr(lib, "dmlc_tpu_abi_version", None)
         if ver_fn is None or int(ver_fn()) != _ABI:
             del lib
@@ -289,6 +289,14 @@ def recordio_frame(payloads: bytes, lens: np.ndarray
 
 # ---- native line-split engine (native/input_split.cc) ----------------------
 
+# read-at callback signature: (ctx, file_idx, offset, buf, size) -> bytes
+# read (0 = EOF), <0 = error.  Python implementations run on the native
+# prefetch thread; ctypes acquires the GIL per call.
+READ_AT_FN = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_void_p,
+                              ctypes.c_int64, ctypes.c_int64,
+                              ctypes.POINTER(ctypes.c_char), ctypes.c_int64)
+
+
 def _load_lsplit():
     lib = _load()
     if lib is None:
@@ -304,8 +312,25 @@ def _load_lsplit():
         lib.dmlc_tpu_lsplit_open.argtypes = open_sig
         lib.dmlc_tpu_rsplit_open.restype = ctypes.c_void_p
         lib.dmlc_tpu_rsplit_open.argtypes = open_sig
+        lib.dmlc_tpu_lsplit_open2.restype = ctypes.c_void_p
+        lib.dmlc_tpu_lsplit_open2.argtypes = open_sig + [
+            ctypes.c_int64, ctypes.c_char_p, READ_AT_FN, ctypes.c_void_p]
+        lib.dmlc_tpu_lsplit_finish_cache.restype = ctypes.c_int64
+        lib.dmlc_tpu_lsplit_finish_cache.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_creplay_open.restype = ctypes.c_void_p
+        lib.dmlc_tpu_creplay_open.argtypes = [ctypes.c_char_p]
+        lib.dmlc_tpu_creplay_reset.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_creplay_next_chunk.restype = ctypes.c_int64
+        lib.dmlc_tpu_creplay_next_chunk.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+        lib.dmlc_tpu_creplay_error.restype = ctypes.c_char_p
+        lib.dmlc_tpu_creplay_error.argtypes = [ctypes.c_void_p]
+        lib.dmlc_tpu_creplay_close.argtypes = [ctypes.c_void_p]
         lib.dmlc_tpu_span_open.restype = ctypes.c_void_p
         lib.dmlc_tpu_span_open.argtypes = open_sig[:4]
+        lib.dmlc_tpu_span_open2.restype = ctypes.c_void_p
+        lib.dmlc_tpu_span_open2.argtypes = open_sig[:4] + [
+            READ_AT_FN, ctypes.c_void_p]
         lib.dmlc_tpu_span_set_plan.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
@@ -350,19 +375,38 @@ class NativeLineSplit:
     None at the end.  ``reset`` re-partitions (or rewinds, with the same
     arguments).  ``format`` selects the record kind: "line" or "recordio"
     (same engine, different realignment scan — native/input_split.cc).
+
+    ``read_at`` (a ``READ_AT_FN``-compatible callable) routes all byte
+    reads through Python — the remote-filesystem path; ``cache_path``
+    tees epoch-1 chunks into a cache file (``finish_cache`` closes it,
+    :class:`NativeCacheReplay` replays it).
     """
 
     def __init__(self, paths, sizes, part: int, nparts: int,
-                 buffer_size: int = 8 << 20, format: str = "line"):
+                 buffer_size: int = 8 << 20, format: str = "line",
+                 read_at=None, cache_path: Optional[str] = None):
         lib = _load_lsplit()
         assert lib is not None
         self._lib = lib
         blob, lens, arr = _encode_files(paths, sizes)
-        open_fn = (lib.dmlc_tpu_rsplit_open if format == "recordio"
-                   else lib.dmlc_tpu_lsplit_open)
-        self._handle = open_fn(
-            blob, lens, arr, len(sizes), part, nparts, buffer_size)
+        # the CFUNCTYPE object must outlive the handle (the prefetch thread
+        # calls through it); keep the reference on self
+        if read_at is not None and not isinstance(read_at, READ_AT_FN):
+            read_at = READ_AT_FN(read_at)
+        self._read_at = read_at
+        self._handle = lib.dmlc_tpu_lsplit_open2(
+            blob, lens, arr, len(sizes), part, nparts, buffer_size,
+            1 if format == "recordio" else 0,
+            cache_path.encode() if cache_path else None,
+            self._read_at if self._read_at is not None
+            else ctypes.cast(None, READ_AT_FN), None)
         self._check()
+
+    def finish_cache(self) -> None:
+        """Drain the rest of the partition through the cache tee and close
+        the cache file (the preproc finish of the cached split)."""
+        if self._lib.dmlc_tpu_lsplit_finish_cache(self._require_open()) != 0:
+            self._check()
 
     def _require_open(self):
         if self._handle is None:
@@ -416,12 +460,18 @@ class NativeSpanReader:
     reads ahead (native/input_split.cc SpanReadEngine).
     """
 
-    def __init__(self, paths, sizes):
+    def __init__(self, paths, sizes, read_at=None):
         lib = _load_lsplit()
         assert lib is not None
         self._lib = lib
         blob, lens, arr = _encode_files(paths, sizes)
-        self._handle = lib.dmlc_tpu_span_open(blob, lens, arr, len(sizes))
+        if read_at is not None and not isinstance(read_at, READ_AT_FN):
+            read_at = READ_AT_FN(read_at)
+        self._read_at = read_at  # keep alive for the prefetch thread
+        self._handle = lib.dmlc_tpu_span_open2(
+            blob, lens, arr, len(sizes),
+            self._read_at if self._read_at is not None
+            else ctypes.cast(None, READ_AT_FN), None)
 
     def _require_open(self):
         if self._handle is None:
@@ -460,6 +510,55 @@ class NativeSpanReader:
     def close(self) -> None:
         if self._handle is not None:
             self._lib.dmlc_tpu_span_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeCacheReplay:
+    """Replays a (u64-LE length, chunk)-framed cache file with native
+    read-ahead — epoch N of the cached split (native/input_split.cc
+    CacheReplayEngine; frame format shared with the Python cache writer)."""
+
+    def __init__(self, path: str):
+        lib = _load_lsplit()
+        assert lib is not None
+        self._lib = lib
+        self._handle = lib.dmlc_tpu_creplay_open(path.encode())
+        self._check()
+
+    def _require_open(self):
+        if self._handle is None:
+            raise ValueError("NativeCacheReplay is closed")
+        return self._handle
+
+    def _check(self):
+        err = self._lib.dmlc_tpu_creplay_error(self._require_open())
+        if err:
+            raise OSError(err.decode())
+
+    def reset(self) -> None:
+        """Rewind to the first frame (epoch boundary)."""
+        self._lib.dmlc_tpu_creplay_reset(self._require_open())
+        self._check()
+
+    def next_chunk(self):
+        ptr = ctypes.c_char_p()
+        n = self._lib.dmlc_tpu_creplay_next_chunk(self._require_open(),
+                                                  ctypes.byref(ptr))
+        if n < 0:
+            self._check()
+        if n <= 0:
+            return None
+        return ctypes.string_at(ptr, n)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dmlc_tpu_creplay_close(self._handle)
             self._handle = None
 
     def __del__(self):

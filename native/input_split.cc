@@ -1,15 +1,20 @@
-// Native input split: byte-range sharding over local files with record
-// realignment at shard edges and a double-buffered prefetch thread.
+// Native input split: byte-range sharding with record realignment at shard
+// edges and a double-buffered prefetch thread.
 //
 // C++ counterpart of dmlc_core_tpu/io/input_split.py (LineSplitter,
-// RecordIOSplitter, IndexedRecordIOSplitter byte paths + ThreadedInputSplit)
-// and of the reference engines they mirror (src/io/input_split_base.cc
-// ResetPartition/ReadChunk, src/io/line_split.cc, src/io/recordio_split.cc
-// magic-resync, src/io/indexed_recordio_split.cc batch reads,
-// src/io/threaded_input_split.h).  The Python layer delegates here when every
-// file is local; remote URIs keep the Python path.  Semantics are kept
-// bit-identical to the Python engine — the all-parts coverage tests diff the
-// two implementations record by record.
+// RecordIOSplitter, IndexedRecordIOSplitter byte paths + ThreadedInputSplit
+// + CachedInputSplit) and of the reference engines they mirror
+// (src/io/input_split_base.cc ResetPartition/ReadChunk, src/io/line_split.cc,
+// src/io/recordio_split.cc magic-resync, src/io/indexed_recordio_split.cc
+// batch reads, src/io/threaded_input_split.h, src/io/cached_input_split.h).
+//
+// Bytes arrive through a ByteSource: local files read FILE* directly; remote
+// URIs read through a caller-provided read-at callback (Python supplies one
+// backed by the remote SeekStream), so the chunking/realignment/prefetch hot
+// path is native for EVERY filesystem.  The epoch-1 producer can tee chunks
+// into a (u64-length-framed) cache file and CacheReplayEngine replays it on
+// later epochs.  Semantics are kept bit-identical to the Python engine — the
+// all-parts coverage tests diff the two implementations record by record.
 
 #ifndef _FILE_OFFSET_BITS
 #define _FILE_OFFSET_BITS 64  // make off_t/fseeko 64-bit on 32-bit targets
@@ -23,11 +28,20 @@
 #include <cstring>
 #include <deque>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
 #include <utility>
 #include <vector>
+
+// read up to `size` bytes of file `file_idx` at `offset`; returns bytes read
+// (0 = EOF), or <0 on error.  Implemented by Python (ctypes CFUNCTYPE over a
+// remote SeekStream) for non-local filesystems; called from the prefetch
+// thread (ctypes acquires the GIL per call).
+extern "C" typedef int64_t (*dmlc_tpu_read_at_fn)(void *ctx, int64_t file_idx,
+                                                  int64_t offset, char *buf,
+                                                  int64_t size);
 
 namespace {
 
@@ -54,6 +68,116 @@ constexpr uint32_t kRecordIOMagic = 0xced7230a;
 inline uint32_t CFlag(uint32_t len_word) { return (len_word >> 29) & 7u; }
 
 enum Format { kLine = 0, kRecordIO = 1 };
+
+// ---- byte sources ----------------------------------------------------------
+// Random-access reads over the job's file list; the engines are written
+// against this interface so local FILE* and remote-callback inputs share
+// one chunking/realignment implementation.
+class ByteSource {
+ public:
+  virtual ~ByteSource() = default;
+  // bytes read (0 = EOF of that file), <0 on error
+  virtual int64_t ReadAt(size_t file_idx, int64_t offset, char *buf,
+                         int64_t size) = 0;
+  virtual std::string LastError() const = 0;
+  // drop cached handles so the next read reopens (a reset must observe
+  // renamed/replaced files, like the reopen-per-reset Python engines)
+  virtual void Invalidate() {}
+};
+
+class LocalSource : public ByteSource {
+ public:
+  explicit LocalSource(std::vector<std::string> paths)
+      : paths_(std::move(paths)) {}
+  ~LocalSource() override {
+    if (fp_) std::fclose(fp_);
+  }
+
+  int64_t ReadAt(size_t idx, int64_t offset, char *buf,
+                 int64_t size) override {
+    if (!fp_ || idx_ != idx) {
+      if (fp_) std::fclose(fp_);
+      fp_ = std::fopen(paths_[idx].c_str(), "rb");
+      if (!fp_) {
+        err_ = "cannot open " + paths_[idx];
+        return -1;
+      }
+      idx_ = idx;
+      pos_ = 0;
+    }
+    if (pos_ != offset) {  // sequential reads skip the syscall
+      if (Seek64(fp_, offset) != 0) {
+        err_ = "seek failed in " + paths_[idx];
+        return -1;
+      }
+      pos_ = offset;
+    }
+    size_t got = std::fread(buf, 1, static_cast<size_t>(size), fp_);
+    pos_ += static_cast<int64_t>(got);
+    if (got == 0 && std::ferror(fp_)) {
+      err_ = "read error in " + paths_[idx];
+      return -1;
+    }
+    return static_cast<int64_t>(got);
+  }
+
+  std::string LastError() const override { return err_; }
+
+  void Invalidate() override {
+    if (fp_) {
+      std::fclose(fp_);
+      fp_ = nullptr;
+    }
+  }
+
+ private:
+  std::vector<std::string> paths_;
+  std::FILE *fp_ = nullptr;
+  size_t idx_ = 0;
+  int64_t pos_ = 0;
+  std::string err_;
+};
+
+class CallbackSource : public ByteSource {
+ public:
+  CallbackSource(dmlc_tpu_read_at_fn fn, void *ctx) : fn_(fn), ctx_(ctx) {}
+
+  int64_t ReadAt(size_t idx, int64_t offset, char *buf,
+                 int64_t size) override {
+    return fn_(ctx_, static_cast<int64_t>(idx), offset, buf, size);
+  }
+
+  // the Python side records the real exception next to the callback; this
+  // is only the native-visible fallback text
+  std::string LastError() const override { return "reader callback failed"; }
+
+ private:
+  dmlc_tpu_read_at_fn fn_;
+  void *ctx_;
+};
+
+std::unique_ptr<ByteSource> MakeSource(const std::vector<FileEnt> &files,
+                                       dmlc_tpu_read_at_fn read_cb,
+                                       void *ctx) {
+  if (read_cb != nullptr) {
+    return std::unique_ptr<ByteSource>(new CallbackSource(read_cb, ctx));
+  }
+  std::vector<std::string> paths;
+  paths.reserve(files.size());
+  for (auto &f : files) paths.push_back(f.path);
+  return std::unique_ptr<ByteSource>(new LocalSource(std::move(paths)));
+}
+
+// little-endian u64 cache-frame header — must match the Python cache format
+// (io/input_split.py CachedInputSplit: struct.pack("<Q", len))
+inline void EncodeU64LE(uint64_t v, unsigned char *out) {
+  for (int i = 0; i < 8; ++i) out[i] = static_cast<unsigned char>(v >> (8 * i));
+}
+inline uint64_t DecodeU64LE(const unsigned char *in) {
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; --i) v = (v << 8) | in[i];
+  return v;
+}
 
 // Shared double-buffered prefetch: one producer thread, queue capacity 2,
 // (ok, chunk) items with an end sentinel that stays queued for repeated
@@ -124,13 +248,28 @@ class PrefetchQueue {
 class LineSplitEngine {
  public:
   LineSplitEngine(std::vector<FileEnt> files, int64_t buffer_size,
-                  Format format = kLine)
+                  Format format = kLine,
+                  dmlc_tpu_read_at_fn read_cb = nullptr, void *ctx = nullptr,
+                  const char *cache_path = nullptr)
       : files_(std::move(files)), buffer_size_(buffer_size), format_(format) {
     offsets_.push_back(0);
     for (auto &f : files_) offsets_.push_back(offsets_.back() + f.size);
+    src_ = MakeSource(files_, read_cb, ctx);
+    if (cache_path != nullptr && cache_path[0] != '\0') {
+      cache_fo_ = std::fopen(cache_path, "wb");
+      if (!cache_fo_) {
+        // sticky: ClearError() on reset must not swallow it — an unusable
+        // cache invalidates the whole cached-split construction
+        sticky_error_ = std::string("cannot create cache ") + cache_path;
+        Fail(sticky_error_);
+      }
+    }
   }
 
-  ~LineSplitEngine() { queue_.Stop(); CloseFile(); }
+  ~LineSplitEngine() {
+    queue_.Stop();
+    if (cache_fo_) std::fclose(cache_fo_);
+  }
 
   int64_t TotalSize() const { return offsets_.back(); }
   std::string Error() const {
@@ -141,13 +280,18 @@ class LineSplitEngine {
   void ResetPartition(int64_t part, int64_t nparts) {
     queue_.Stop();
     ClearError();  // a past transient failure must not poison future resets
+    src_->Invalidate();
     if (!DoResetPartition(part, nparts)) {
       // empty partition or failure: queue the end sentinel so PopChunk
       // never blocks waiting on a producer that was never started
       queue_.PushEnd();
       return;
     }
-    queue_.Start([this](std::vector<char> *c) { return NextChunk(c); });
+    queue_.Start([this](std::vector<char> *c) {
+      bool ok = NextChunk(c);
+      if (ok && cache_fo_) WriteCacheFrame(*c);
+      return ok;
+    });
   }
 
   bool DoResetPartition(int64_t part, int64_t nparts) {
@@ -158,37 +302,40 @@ class LineSplitEngine {
     begin_ = std::min(nstep * part, ntotal);
     end_ = std::min(nstep * (part + 1), ntotal);
     overflow_.clear();
-    if (begin_ >= end_) { curr_ = begin_; CloseFile(); return false; }
+    if (begin_ >= end_) { curr_ = begin_; return false; }
     // realign the end edge to the next record head inside its file
     size_t fend = UpperBound(end_);
     if (end_ != offsets_[fend]) {
-      std::FILE *fp = std::fopen(files_[fend].path.c_str(), "rb");
-      if (!fp) { Fail("cannot open " + files_[fend].path); return false; }
-      Seek64(fp, end_ - offsets_[fend]);
-      end_ += SeekRecordBegin(fp);
-      std::fclose(fp);
+      end_ += SeekRecordBegin(fend, end_ - offsets_[fend]);
+      if (failed()) return false;
     }
     // realign the begin edge likewise
-    file_ptr_ = UpperBound(begin_);
-    if (!OpenFile(file_ptr_)) return false;
-    if (begin_ != offsets_[file_ptr_]) {
-      Seek64(fp_, begin_ - offsets_[file_ptr_]);
-      begin_ += SeekRecordBegin(fp_);
+    size_t fbegin = UpperBound(begin_);
+    if (begin_ != offsets_[fbegin]) {
+      begin_ += SeekRecordBegin(fbegin, begin_ - offsets_[fbegin]);
+      if (failed()) return false;
     }
     BeforeFirst();
     return !failed();
   }
 
   void BeforeFirst() {
-    if (begin_ >= end_) return;
-    size_t fptr = UpperBound(begin_);
-    if (!fp_ || file_ptr_ != fptr) {
-      file_ptr_ = fptr;
-      if (!OpenFile(file_ptr_)) return;
-    }
-    Seek64(fp_, begin_ - offsets_[file_ptr_]);
     curr_ = begin_;
     overflow_.clear();
+  }
+
+  // drain the remaining chunks (tee keeps writing them to the cache), then
+  // flush+close the cache file — the native half of the reference's
+  // cached-split preproc finish (cached_input_split.h:63-86)
+  bool FinishCache() {
+    std::vector<char> sink;
+    while (queue_.Pop(&sink)) {
+    }
+    if (cache_fo_) {
+      if (std::fclose(cache_fo_) != 0) Fail("cache flush failed");
+      cache_fo_ = nullptr;
+    }
+    return !failed();
   }
 
   // next chunk of whole records into out; false at partition end
@@ -223,7 +370,7 @@ class LineSplitEngine {
 
   void ClearError() {
     std::lock_guard<std::mutex> lk(err_mu_);
-    error_.clear();
+    error_ = sticky_error_;  // construction-time failures survive resets
   }
 
  private:
@@ -238,32 +385,47 @@ class LineSplitEngine {
     return static_cast<size_t>(it - offsets_.begin()) - 1;
   }
 
-  bool OpenFile(size_t idx) {
-    CloseFile();
-    fp_ = std::fopen(files_[idx].path.c_str(), "rb");
-    if (!fp_) { Fail("cannot open " + files_[idx].path); return false; }
-    return true;
+  // fill `size` bytes of file idx at `offset` (looping over short reads);
+  // returns bytes filled — short only at file EOF, <0 already Fail()ed
+  int64_t FillAt(size_t idx, int64_t offset, char *buf, int64_t size) {
+    int64_t got = 0;
+    while (got < size) {
+      int64_t n = src_->ReadAt(idx, offset + got, buf + got, size - got);
+      if (n < 0) { Fail(src_->LastError()); return -1; }
+      if (n == 0) break;
+      got += n;
+    }
+    return got;
   }
 
-  void CloseFile() {
-    if (fp_) { std::fclose(fp_); fp_ = nullptr; }
+  void WriteCacheFrame(const std::vector<char> &chunk) {
+    unsigned char hdr[8];
+    EncodeU64LE(static_cast<uint64_t>(chunk.size()), hdr);
+    if (std::fwrite(hdr, 1, 8, cache_fo_) != 8 ||
+        std::fwrite(chunk.data(), 1, chunk.size(), cache_fo_) !=
+            chunk.size()) {
+      Fail("cache write failed");
+    }
   }
 
-  // bytes to skip from the current position to the next record head
-  int64_t SeekRecordBegin(std::FILE *fp) {
-    return format_ == kRecordIO ? SeekRecordBeginRecordIO(fp)
-                                : SeekRecordBeginLine(fp);
+  // bytes to skip from (idx, local offset) to the next record head; the
+  // scan stays within file idx (reference realigns per file)
+  int64_t SeekRecordBegin(size_t idx, int64_t local) {
+    return format_ == kRecordIO ? SeekRecordBeginRecordIO(idx, local)
+                                : SeekRecordBeginLine(idx, local);
   }
 
   // (reference line_split.cc:9-26: to first EOL, then past the EOL run)
-  static int64_t SeekRecordBeginLine(std::FILE *fp) {
+  int64_t SeekRecordBeginLine(size_t idx, int64_t local) {
+    int64_t consumed = 0;  // bytes pulled from the source so far
     int64_t nstep = 0;
     bool seen_eol = false;
     char block[4096];
     while (true) {
-      size_t n = std::fread(block, 1, sizeof(block), fp);
-      if (n == 0) return nstep;
-      for (size_t i = 0; i < n; ++i) {
+      int64_t n = FillAt(idx, local + consumed, block, sizeof(block));
+      if (n <= 0) return nstep;
+      consumed += n;
+      for (int64_t i = 0; i < n; ++i) {
         unsigned char c = static_cast<unsigned char>(block[i]);
         if (!seen_eol) {
           ++nstep;
@@ -280,15 +442,17 @@ class LineSplitEngine {
   // word-scan for magic followed by cflag 0/1 (reference
   // recordio_split.cc:9-26; mirrors RecordIOSplitter.seek_record_begin in
   // io/input_split.py — incl. consuming the word after a failed flag test)
-  static int64_t SeekRecordBeginRecordIO(std::FILE *fp) {
+  int64_t SeekRecordBeginRecordIO(size_t idx, int64_t local) {
+    int64_t consumed = 0;  // bytes pulled from the source so far
     int64_t nstep = 0;
     bool saw_magic = false;
     char block[4096];
     while (true) {
-      size_t n = std::fread(block, 1, sizeof(block), fp);
-      size_t nwords = n / 4;
-      if (nwords == 0) return nstep;
-      for (size_t i = 0; i < nwords; ++i) {
+      int64_t n = FillAt(idx, local + consumed, block, sizeof(block));
+      if (n < 4) return nstep;
+      consumed += n;
+      int64_t nwords = n / 4;
+      for (int64_t i = 0; i < nwords; ++i) {
         uint32_t w;
         std::memcpy(&w, block + i * 4, 4);
         nstep += 4;
@@ -326,26 +490,24 @@ class LineSplitEngine {
 
   // read up to `size` partition bytes, crossing file boundaries
   int64_t Read(char *buf, int64_t size) {
-    if (begin_ >= end_ || !fp_) return 0;
+    if (begin_ >= end_ || curr_ >= end_) return 0;
     size = std::min(size, end_ - curr_);
-    int64_t got = 0;
-    while (got < size) {
-      size_t n = std::fread(buf + got, 1, static_cast<size_t>(size - got),
-                            fp_);
-      if (n > 0) {
-        got += static_cast<int64_t>(n);
-        curr_ += static_cast<int64_t>(n);
-        continue;
+    int64_t got_total = 0;
+    while (got_total < size) {
+      size_t idx = UpperBound(curr_);
+      if (idx >= files_.size()) break;
+      int64_t local = curr_ - offsets_[idx];
+      int64_t avail = std::min(size - got_total, files_[idx].size - local);
+      int64_t got = FillAt(idx, local, buf + got_total, avail);
+      if (got < 0) return got_total;
+      if (got < avail) {
+        Fail("file shorter than its size table entry: " + files_[idx].path);
+        return got_total + got;
       }
-      if (curr_ != offsets_[file_ptr_ + 1]) {
-        Fail("file offset not calculated correctly");
-        return got;
-      }
-      if (file_ptr_ + 1 >= files_.size()) break;
-      ++file_ptr_;
-      if (!OpenFile(file_ptr_)) return got;
+      got_total += got;
+      curr_ += got;
     }
-    return got;
+    return got_total;
   }
 
   // one chunk ending at a record boundary; false at partition end,
@@ -372,10 +534,96 @@ class LineSplitEngine {
   std::vector<int64_t> offsets_;
   std::atomic<int64_t> buffer_size_;
   Format format_;
-  std::FILE *fp_ = nullptr;
-  size_t file_ptr_ = 0;
+  std::unique_ptr<ByteSource> src_;
+  std::FILE *cache_fo_ = nullptr;
   int64_t begin_ = 0, end_ = 0, curr_ = 0;
   std::vector<char> overflow_;
+  mutable std::mutex err_mu_;
+  std::string error_;
+  std::string sticky_error_;  // set at construction only (cache open)
+  PrefetchQueue queue_;
+};
+
+// Replays a (u64-LE length, bytes)-framed cache file with read-ahead — the
+// epoch-N half of the reference's CachedInputSplit (cached_input_split.h:
+// 166-189); frame format shared with the Python cache writer.
+class CacheReplayEngine {
+ public:
+  explicit CacheReplayEngine(std::string path) : path_(std::move(path)) {
+    Reset();
+  }
+
+  ~CacheReplayEngine() {
+    queue_.Stop();
+    if (fp_) std::fclose(fp_);
+  }
+
+  void Reset() {
+    queue_.Stop();
+    {
+      std::lock_guard<std::mutex> lk(err_mu_);
+      error_.clear();
+    }
+    if (fp_) {
+      std::fclose(fp_);
+      fp_ = nullptr;
+    }
+    fp_ = std::fopen(path_.c_str(), "rb");
+    if (!fp_) {
+      Fail("cannot open cache " + path_);
+      queue_.PushEnd();
+      return;
+    }
+    // remaining-bytes bound for frame-length validation: a corrupt header
+    // must fail cleanly, not feed a garbage u64 into vector::resize
+    std::fseek(fp_, 0, SEEK_END);
+    remaining_ = std::ftell(fp_);
+    std::fseek(fp_, 0, SEEK_SET);
+    queue_.Start([this](std::vector<char> *c) { return NextFrame(c); });
+  }
+
+  bool PopChunk(std::vector<char> *out) { return queue_.Pop(out); }
+
+  std::string Error() const {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    return error_;
+  }
+  bool failed() const {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    return !error_.empty();
+  }
+
+ private:
+  bool NextFrame(std::vector<char> *out) {
+    unsigned char hdr[8];
+    size_t n = std::fread(hdr, 1, 8, fp_);
+    if (n < 8) {
+      if (n != 0) Fail("truncated cache frame header");
+      return false;
+    }
+    remaining_ -= 8;
+    uint64_t len = DecodeU64LE(hdr);
+    if (len > static_cast<uint64_t>(remaining_)) {
+      Fail("corrupt cache file (frame length exceeds file size)");
+      return false;
+    }
+    out->resize(static_cast<size_t>(len));
+    if (std::fread(out->data(), 1, out->size(), fp_) != out->size()) {
+      Fail("corrupt cache file (truncated frame)");
+      return false;
+    }
+    remaining_ -= static_cast<int64_t>(len);
+    return !out->empty();  // writers never emit empty frames
+  }
+
+  void Fail(const std::string &msg) {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    if (error_.empty()) error_ = msg;
+  }
+
+  std::string path_;
+  std::FILE *fp_ = nullptr;
+  int64_t remaining_ = 0;  // bytes left in the file (producer thread only)
   mutable std::mutex err_mu_;
   std::string error_;
   PrefetchQueue queue_;
@@ -390,13 +638,16 @@ class LineSplitEngine {
 // chunk and read ahead by a producer thread.
 class SpanReadEngine {
  public:
-  explicit SpanReadEngine(std::vector<FileEnt> files)
+  explicit SpanReadEngine(std::vector<FileEnt> files,
+                          dmlc_tpu_read_at_fn read_cb = nullptr,
+                          void *ctx = nullptr)
       : files_(std::move(files)) {
     offsets_.push_back(0);
     for (auto &f : files_) offsets_.push_back(offsets_.back() + f.size);
+    src_ = MakeSource(files_, read_cb, ctx);
   }
 
-  ~SpanReadEngine() { queue_.Stop(); CloseFile(); }
+  ~SpanReadEngine() { queue_.Stop(); }
 
   std::string Error() const {
     std::lock_guard<std::mutex> lk(err_mu_);
@@ -410,9 +661,7 @@ class SpanReadEngine {
   void SetPlan(const int64_t *offs, const int64_t *sizes,
                const int64_t *counts, int64_t nspans, int64_t nbatches) {
     queue_.Stop();
-    // a failed prior epoch may have left the OS file position ahead of
-    // curr_ (short-read abort); force a clean reopen + seek
-    CloseFile();
+    src_->Invalidate();  // a new epoch must observe replaced files
     {
       std::lock_guard<std::mutex> lk(err_mu_);
       error_.clear();
@@ -457,25 +706,15 @@ class SpanReadEngine {
     while (size > 0) {
       size_t idx = UpperBound(offset);
       if (idx >= files_.size()) { Fail("span beyond input"); return false; }
-      if (!EnsureOpen(idx)) return false;
       int64_t local = offset - offsets_[idx];
-      if (curr_ != local) {
-        if (Seek64(fp_, local) != 0) { Fail("seek failed"); return false; }
-        curr_ = local;
-      }
       int64_t avail = std::min(size, files_[idx].size - local);
       int64_t got = 0;
       while (got < avail) {
-        size_t n = std::fread(dst + got, 1,
-                              static_cast<size_t>(avail - got), fp_);
-        if (n == 0) {
-          curr_ += got;  // keep curr_ == OS position even on the error path
-          Fail("short read in " + files_[idx].path);
-          return false;
-        }
-        got += static_cast<int64_t>(n);
+        int64_t n = src_->ReadAt(idx, local + got, dst + got, avail - got);
+        if (n < 0) { Fail(src_->LastError()); return false; }
+        if (n == 0) { Fail("short read in " + files_[idx].path); return false; }
+        got += n;
       }
-      curr_ += got;
       dst += got;
       offset += got;
       size -= got;
@@ -488,20 +727,6 @@ class SpanReadEngine {
     return static_cast<size_t>(it - offsets_.begin()) - 1;
   }
 
-  bool EnsureOpen(size_t idx) {
-    if (fp_ && file_ptr_ == idx) return true;
-    CloseFile();
-    fp_ = std::fopen(files_[idx].path.c_str(), "rb");
-    if (!fp_) { Fail("cannot open " + files_[idx].path); return false; }
-    file_ptr_ = idx;
-    curr_ = 0;
-    return true;
-  }
-
-  void CloseFile() {
-    if (fp_) { std::fclose(fp_); fp_ = nullptr; }
-  }
-
   void Fail(const std::string &msg) {
     std::lock_guard<std::mutex> lk(err_mu_);
     if (error_.empty()) error_ = msg;
@@ -512,9 +737,7 @@ class SpanReadEngine {
   std::vector<std::pair<int64_t, int64_t>> spans_;
   std::vector<int64_t> counts_;
   int64_t next_batch_ = 0, next_span_ = 0;
-  std::FILE *fp_ = nullptr;
-  size_t file_ptr_ = 0;
-  int64_t curr_ = 0;
+  std::unique_ptr<ByteSource> src_;
   mutable std::mutex err_mu_;
   std::string error_;
   PrefetchQueue queue_;
@@ -528,6 +751,12 @@ struct SplitHandle {
 
 struct SpanHandle {
   SpanReadEngine *engine = nullptr;
+  std::vector<char> current;
+  std::string error;
+};
+
+struct ReplayHandle {
+  CacheReplayEngine *engine = nullptr;
   std::vector<char> current;
   std::string error;
 };
@@ -550,17 +779,31 @@ extern "C" {
 
 // paths: concatenated path bytes with per-path byte lengths in path_lens
 // (length-delimited, so any legal filename byte — incl. '\n' — is safe);
-// sizes: per-file byte sizes
+// sizes: per-file byte sizes.  format: 0 = line, 1 = recordio.
+// read_cb/ctx: non-null routes ALL byte reads through the callback (remote
+// filesystems); cache_path: non-empty tees epoch-1 chunks into a cache file
+// (finish with dmlc_tpu_lsplit_finish_cache, replay with creplay_*).
+void *dmlc_tpu_lsplit_open2(const char *paths, const int64_t *path_lens,
+                            const int64_t *sizes, int64_t nfiles,
+                            int64_t part, int64_t nparts,
+                            int64_t buffer_size, int64_t format,
+                            const char *cache_path,
+                            dmlc_tpu_read_at_fn read_cb, void *ctx) {
+  auto *h = new SplitHandle();
+  h->engine = new LineSplitEngine(
+      DecodeFiles(paths, path_lens, sizes, nfiles), buffer_size,
+      format == 1 ? kRecordIO : kLine, read_cb, ctx, cache_path);
+  h->engine->ResetPartition(part, nparts);
+  if (h->engine->failed()) h->error = h->engine->Error();
+  return h;
+}
+
 void *dmlc_tpu_lsplit_open(const char *paths, const int64_t *path_lens,
                            const int64_t *sizes, int64_t nfiles,
                            int64_t part, int64_t nparts,
                            int64_t buffer_size) {
-  auto *h = new SplitHandle();
-  h->engine = new LineSplitEngine(
-      DecodeFiles(paths, path_lens, sizes, nfiles), buffer_size, kLine);
-  h->engine->ResetPartition(part, nparts);
-  if (h->engine->failed()) h->error = h->engine->Error();
-  return h;
+  return dmlc_tpu_lsplit_open2(paths, path_lens, sizes, nfiles, part, nparts,
+                               buffer_size, 0, nullptr, nullptr, nullptr);
 }
 
 // RecordIO variant: same handle/call surface as lsplit_* (hint/total/reset/
@@ -569,21 +812,75 @@ void *dmlc_tpu_rsplit_open(const char *paths, const int64_t *path_lens,
                            const int64_t *sizes, int64_t nfiles,
                            int64_t part, int64_t nparts,
                            int64_t buffer_size) {
-  auto *h = new SplitHandle();
-  h->engine = new LineSplitEngine(
-      DecodeFiles(paths, path_lens, sizes, nfiles), buffer_size, kRecordIO);
-  h->engine->ResetPartition(part, nparts);
+  return dmlc_tpu_lsplit_open2(paths, path_lens, sizes, nfiles, part, nparts,
+                               buffer_size, 1, nullptr, nullptr, nullptr);
+}
+
+// drain the remaining partition through the cache tee and close the cache
+// file; 0 on success, -1 on error (then lsplit_error has the message)
+int64_t dmlc_tpu_lsplit_finish_cache(void *handle) {
+  auto *h = static_cast<SplitHandle *>(handle);
+  if (!h->engine->FinishCache()) {
+    h->error = h->engine->Error();
+    return -1;
+  }
+  return 0;
+}
+
+// ---- cache replay (epoch N of the cached split) ----------------------------
+
+void *dmlc_tpu_creplay_open(const char *path) {
+  auto *h = new ReplayHandle();
+  h->engine = new CacheReplayEngine(path);
   if (h->engine->failed()) h->error = h->engine->Error();
   return h;
 }
 
+void dmlc_tpu_creplay_reset(void *handle) {
+  auto *h = static_cast<ReplayHandle *>(handle);
+  h->error.clear();
+  h->engine->Reset();
+  if (h->engine->failed()) h->error = h->engine->Error();
+}
+
+// returns chunk length (>0), 0 at cache end, -1 on error
+int64_t dmlc_tpu_creplay_next_chunk(void *handle, const char **ptr) {
+  auto *h = static_cast<ReplayHandle *>(handle);
+  if (!h->error.empty()) return -1;
+  if (!h->engine->PopChunk(&h->current)) {
+    if (h->engine->failed()) { h->error = h->engine->Error(); return -1; }
+    return 0;
+  }
+  if (h->engine->failed()) { h->error = h->engine->Error(); return -1; }
+  *ptr = h->current.data();
+  return static_cast<int64_t>(h->current.size());
+}
+
+const char *dmlc_tpu_creplay_error(void *handle) {
+  return static_cast<ReplayHandle *>(handle)->error.c_str();
+}
+
+void dmlc_tpu_creplay_close(void *handle) {
+  auto *h = static_cast<ReplayHandle *>(handle);
+  delete h->engine;
+  delete h;
+}
+
 // ---- index-driven span reader (indexed recordio batches) -------------------
+
+void *dmlc_tpu_span_open2(const char *paths, const int64_t *path_lens,
+                          const int64_t *sizes, int64_t nfiles,
+                          dmlc_tpu_read_at_fn read_cb, void *ctx) {
+  auto *h = new SpanHandle();
+  h->engine = new SpanReadEngine(DecodeFiles(paths, path_lens, sizes, nfiles),
+                                 read_cb, ctx);
+  return h;
+}
 
 void *dmlc_tpu_span_open(const char *paths, const int64_t *path_lens,
                          const int64_t *sizes, int64_t nfiles) {
-  auto *h = new SpanHandle();
-  h->engine = new SpanReadEngine(DecodeFiles(paths, path_lens, sizes, nfiles));
-  return h;
+  return dmlc_tpu_span_open2(paths, path_lens, sizes, nfiles, nullptr,
+                             nullptr);
 }
 
 void dmlc_tpu_span_set_plan(void *handle, const int64_t *offs,

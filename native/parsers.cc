@@ -345,7 +345,7 @@ void* dmlc_tpu_parse_libfm(const char* data, int64_t len, int nthread) {
 // ABI version handshake: the ctypes bridge refuses (and rebuilds) a stale
 // library whose entry points don't match what it expects.  Bump on any
 // signature change.
-int dmlc_tpu_abi_version() { return 2; }
+int dmlc_tpu_abi_version() { return 3; }
 
 void* dmlc_tpu_parse_csv(const char* data, int64_t len, int nthread,
                          float missing) {

@@ -196,7 +196,10 @@ def test_cached_split(tmp_path):
     uri, all_lines = make_text_files(tmp_path, nfiles=1, nlines=60)
     cache = tmp_path / "cache.bin"
     split = create_input_split(f"{uri}#{cache}", 0, 1, "text")
-    assert isinstance(split, CachedInputSplit)
+    from dmlc_core_tpu.io.input_split import NativeCachedSplitter
+
+    # native cached split when the C++ core is built, Python fallback else
+    assert isinstance(split, (CachedInputSplit, NativeCachedSplitter))
     first = collect_records(split)
     assert first == all_lines
     assert cache.exists() and cache.stat().st_size > 0
