@@ -34,21 +34,10 @@ def _mk_text(path, size_mb):
 
 def _mk_recordio(path, size_mb):
     from dmlc_core_tpu.io import recordio as rio
+    from dmlc_core_tpu.io.stream import create_stream
 
-    class _Buf:
-        def __init__(self, f):
-            self.f = f
-            self.off = 0
-
-        def write(self, d):
-            self.f.write(d)
-            self.off += len(d)
-
-        def tell(self):
-            return self.off
-
-    with open(path, "wb") as f:
-        w = rio.RecordIOWriter(_Buf(f))
+    with create_stream(path, "w") as f:
+        w = rio.RecordIOWriter(f)
         payload = b"r" * 600
         n = size_mb * (1 << 20) // 608
         w.write_records([payload] * n)

@@ -34,6 +34,7 @@ core (dmlc_core_tpu/native) accelerates the same entry points when built.
 
 from __future__ import annotations
 
+import ctypes
 import os
 import random
 import re
@@ -589,6 +590,10 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             InputSplitBase.before_first(self)
         reader = self._native_reader()
         if reader is not None:
+            if self._span_adapter is not None:
+                # new epoch: drop cached remote streams (producer-side, on
+                # its next read) and forget any stale parked error
+                self._span_adapter.request_reopen()
             offs, szs, counts = self._epoch_plan()
             reader.set_plan(offs, szs, counts)
             self._plan_batch = self._batch_size
@@ -1071,8 +1076,6 @@ class _ReadAtAdapter:
         self.error: Optional[BaseException] = None
 
     def __call__(self, ctx, idx, offset, buf, size) -> int:
-        import ctypes
-
         try:
             if self._reopen:
                 # stream teardown runs HERE, on the producer thread that

@@ -99,7 +99,11 @@ class _S3Client:
         path = self.base_path + ("/" + key.lstrip("/") if key else "")
         payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA
         qs = urllib.parse.urlencode(sorted(query.items()))
-        url = path + (f"?{qs}" if qs else "")
+        # the wire path must be the percent-encoded form (spaces/unicode in
+        # keys are illegal in an HTTP request line); sign_request encodes
+        # the raw path identically for the canonical URI, so wire == signed
+        url = (urllib.parse.quote(path, safe="/-_.~")
+               + (f"?{qs}" if qs else ""))
 
         def perform():
             # sign per attempt: long backoffs must not outlive the SigV4

@@ -60,6 +60,21 @@ inline int Seek64(std::FILE *fp, int64_t off) {
 #endif
 }
 
+inline int64_t FileSize64(std::FILE *fp) {
+  // 64-bit-safe size probe (std::ftell returns a 32-bit long on Windows
+  // and ILP32 — a >2 GiB cache would read as negative/truncated)
+#if defined(_WIN32)
+  _fseeki64(fp, 0, SEEK_END);
+  int64_t n = _ftelli64(fp);
+  _fseeki64(fp, 0, SEEK_SET);
+#else
+  fseeko(fp, 0, SEEK_END);
+  int64_t n = static_cast<int64_t>(ftello(fp));
+  fseeko(fp, 0, SEEK_SET);
+#endif
+  return n;
+}
+
 bool IsEol(unsigned char c) { return c == '\n' || c == '\r'; }
 
 // RecordIO framing constants (dmlc_core_tpu/io/recordio.py, reference
@@ -576,9 +591,7 @@ class CacheReplayEngine {
     }
     // remaining-bytes bound for frame-length validation: a corrupt header
     // must fail cleanly, not feed a garbage u64 into vector::resize
-    std::fseek(fp_, 0, SEEK_END);
-    remaining_ = std::ftell(fp_);
-    std::fseek(fp_, 0, SEEK_SET);
+    remaining_ = FileSize64(fp_);
     queue_.Start([this](std::vector<char> *c) { return NextFrame(c); });
   }
 

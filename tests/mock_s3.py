@@ -2,18 +2,107 @@
 the reference's real-bucket soak, test/README.md:1-30).
 
 Implements the subset our client uses: PUT/GET(Range)/HEAD objects,
-ListObjectsV2 with prefix+delimiter, and the multipart-upload flow
-(initiate / upload part / complete).  Verifies that every request carries a
-SigV4 Authorization header.
+ListObjectsV2 with prefix+delimiter+pagination, and the multipart-upload
+flow (initiate / upload part / complete).
+
+STRICT by default (round 4; no real endpoint is reachable in this image, so
+the mock carries the conformance duties a minio smoke would have): every
+request's SigV4 signature is recomputed server-side from the wire form —
+canonical URI taken raw, canonical query rebuilt from decoded pairs, the
+derived signing key, the whole dance — and the x-amz-content-sha256 payload
+hash is checked against the received body.  A client that encodes URLs or
+canonicalizes differently from what it signs fails here exactly as it would
+against AWS (403 SignatureDoesNotMatch), which is the real-endpoint
+breakage class (auth / URL-encoding / pagination) this server exists to
+catch.
 """
 
 from __future__ import annotations
 
 import hashlib
+import hmac
+import os
+import re
 import socket
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_AUTH_RE = re.compile(
+    r"AWS4-HMAC-SHA256 Credential=([^,]+),\s*"
+    r"SignedHeaders=([^,]+),\s*Signature=([0-9a-f]{64})")
+
+
+def _aws_quote(s: str) -> str:
+    return urllib.parse.quote(s, safe="-_.~")
+
+
+def verify_sigv4(handler, body: bytes, secrets=None):
+    """Recompute the request's SigV4 signature the way a real endpoint does
+    and return None when it matches, else a short failure reason.
+    ``secrets``: registered keys; defaults to the env credentials."""
+    auth = handler.headers.get("Authorization", "")
+    m = _AUTH_RE.match(auth)
+    if not m:
+        return "missing or malformed sigv4 Authorization"
+    credential, signed_headers, got_sig = m.groups()
+    cred_parts = credential.split("/")
+    if len(cred_parts) != 5 or cred_parts[4] != "aws4_request":
+        return "malformed credential scope"
+    _access, datestamp, region, service, _term = cred_parts
+    amzdate = handler.headers.get("x-amz-date", "")
+    if not amzdate.startswith(datestamp):
+        return "x-amz-date does not match credential date"
+    payload_hash = handler.headers.get("x-amz-content-sha256", "")
+    if not payload_hash:
+        return "missing x-amz-content-sha256"
+    if (payload_hash != "UNSIGNED-PAYLOAD"
+            and hashlib.sha256(body).hexdigest() != payload_hash):
+        return "payload hash mismatch"
+    parsed = urllib.parse.urlparse(handler.path)
+    # canonical URI: S3 servers use the raw received path (no normalization)
+    canon_uri = parsed.path or "/"
+    # canonical query: decoded pairs re-encoded with AWS rules, sorted
+    pairs = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+    canon_query = "&".join(
+        f"{_aws_quote(k)}={_aws_quote(v)}" for k, v in sorted(pairs))
+    names = signed_headers.split(";")
+    if sorted(names) != names:
+        return "SignedHeaders not sorted"
+    canon_headers = "".join(
+        f"{h}:{' '.join((handler.headers.get(h) or '').split())}\n"
+        for h in names)
+    canonical_request = "\n".join([
+        handler.command, canon_uri, canon_query, canon_headers,
+        signed_headers, payload_hash])
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amzdate, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()])
+
+    def _hmac(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    # the server knows every registered credential (AWS- and GCS-interop
+    # HMAC keys); the request must verify under one of them
+    if secrets is None:
+        secrets = [os.environ.get(name) for name in
+                   ("AWS_SECRET_ACCESS_KEY", "GCS_SECRET_ACCESS_KEY")]
+    for secret in filter(None, secrets):
+        k = _hmac(("AWS4" + secret).encode(), datestamp)
+        k = _hmac(k, region)
+        k = _hmac(k, service)
+        k = _hmac(k, "aws4_request")
+        want = hmac.new(k, string_to_sign.encode(),
+                        hashlib.sha256).hexdigest()
+        if hmac.compare_digest(want, got_sig):
+            return None
+    return "SignatureDoesNotMatch"
+
+
+def _xml_escape(s: str) -> str:
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;"))
 
 
 
@@ -33,7 +122,15 @@ def drop_mid_body(handler, status, body):
 
 
 class MockS3:
-    def __init__(self, fail_every: int = 0):
+    def __init__(self, fail_every: int = 0, strict: bool = True,
+                 page_size: int = 0, secrets=None):
+        # strict: full server-side SigV4 + payload-hash verification
+        # page_size: >0 forces ListObjectsV2 pagination at that many keys
+        # (clients must follow NextContinuationToken)
+        # secrets: pin the registered keys (default: read env per request)
+        self.strict = strict
+        self.page_size = page_size
+        self.secrets = secrets
         self.objects = {}      # (bucket, key) -> bytes
         self.etags = {}        # (bucket, key) -> etag (no quotes)
         self.meta = {}         # (bucket, key) -> {meta header: value}
@@ -63,9 +160,12 @@ class MockS3:
 
             def _parse(self):
                 parsed = urllib.parse.urlparse(self.path)
+                # split on the (encoded) separator FIRST, then decode each
+                # part — %2F inside a key must not become a separator
                 parts = parsed.path.lstrip("/").split("/", 1)
-                bucket = parts[0]
-                key = parts[1] if len(parts) > 1 else ""
+                bucket = urllib.parse.unquote(parts[0])
+                key = (urllib.parse.unquote(parts[1])
+                       if len(parts) > 1 else "")
                 query = dict(urllib.parse.parse_qsl(parsed.query,
                                                     keep_blank_values=True))
                 return bucket, key, query
@@ -80,7 +180,15 @@ class MockS3:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _check_auth(self):
+            def _check_auth(self, body: bytes = b""):
+                if store.strict:
+                    why = verify_sigv4(self, body, secrets=store.secrets)
+                    if why is not None:
+                        self._reply(403, (f"<Error><Code>SignatureDoesNot"
+                                          f"Match</Code><Message>{why}"
+                                          f"</Message></Error>").encode())
+                        return False
+                    return True
                 auth = self.headers.get("Authorization", "")
                 if not auth.startswith("AWS4-HMAC-SHA256"):
                     self._reply(403, b"<Error>missing sigv4</Error>")
@@ -144,7 +252,9 @@ class MockS3:
             def _list(self, bucket, query):
                 prefix = query.get("prefix", "")
                 delim = query.get("delimiter", "")
-                contents, prefixes = [], set()
+                after = query.get("continuation-token", "")
+                entries = []   # (key, size) leaves and (prefix, None) dirs
+                prefixes = set()
                 for (b, k), v in sorted(store.objects.items()):
                     if b != bucket or not k.startswith(prefix):
                         continue
@@ -152,22 +262,39 @@ class MockS3:
                     if delim and delim in rest:
                         prefixes.add(prefix + rest.split(delim)[0] + delim)
                     else:
-                        contents.append(
-                            f"<Contents><Key>{k}</Key>"
-                            f"<Size>{len(v)}</Size></Contents>")
-                cps = "".join(f"<CommonPrefixes><Prefix>{p}</Prefix>"
-                              f"</CommonPrefixes>" for p in sorted(prefixes))
-                body = (f"<ListBucketResult>{''.join(contents)}{cps}"
-                        f"</ListBucketResult>").encode()
+                        entries.append((k, len(v)))
+                # pagination over leaf keys (continuation token = last key
+                # of the previous page, opaque to the client).  Common
+                # prefixes go out exactly once — on the first page — like
+                # real S3, which never repeats a prefix across pages
+                if after:
+                    entries = [e for e in entries if e[0] > after]
+                    prefixes = set()
+                truncated = False
+                if store.page_size and len(entries) > store.page_size:
+                    entries = entries[:store.page_size]
+                    truncated = True
+                contents = "".join(
+                    f"<Contents><Key>{_xml_escape(k)}</Key>"
+                    f"<Size>{n}</Size></Contents>" for k, n in entries)
+                cps = "".join(f"<CommonPrefixes><Prefix>{_xml_escape(p)}"
+                              f"</Prefix></CommonPrefixes>"
+                              for p in sorted(prefixes))
+                nct = (f"<NextContinuationToken>"
+                       f"{_xml_escape(entries[-1][0])}"
+                       f"</NextContinuationToken>" if truncated else "")
+                body = (f"<ListBucketResult><IsTruncated>"
+                        f"{'true' if truncated else 'false'}</IsTruncated>"
+                        f"{contents}{cps}{nct}</ListBucketResult>").encode()
                 self._reply(200, body)
 
             def do_PUT(self):
-                if not self._check_auth():
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if not self._check_auth(body):
                     return
                 bucket, key, query = self._parse()
                 store.requests.append(("PUT", self.path))
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length)
                 if "uploadId" in query:
                     uid = query["uploadId"]
                     part = int(query["partNumber"])
@@ -188,12 +315,12 @@ class MockS3:
                 self._reply(200, b"", {"ETag": '"etag"'})
 
             def do_POST(self):
-                if not self._check_auth():
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if not self._check_auth(body):
                     return
                 bucket, key, query = self._parse()
                 store.requests.append(("POST", self.path))
-                length = int(self.headers.get("Content-Length", 0))
-                self.rfile.read(length)
                 if "uploads" in query:
                     meta = {k.lower(): v for k, v in self.headers.items()
                             if k.lower().startswith("x-amz-meta-")}

@@ -7,7 +7,6 @@ pin both against the pure-Python engines (reference
 src/io/input_split_base.cc:205-233, src/io/cached_input_split.h:28-189).
 """
 
-import io
 import os
 
 import pytest
@@ -55,21 +54,13 @@ def _s3_fs():
 
 
 def _recordio_blob(records):
-    class _Buf:
-        def __init__(self):
-            self.b = io.BytesIO()
+    from dmlc_core_tpu.io.memory_io import MemoryStringStream
 
-        def write(self, data):
-            self.b.write(data)
-
-        def tell(self):
-            return self.b.tell()
-
-    buf = _Buf()
+    buf = MemoryStringStream()
     w = rio.RecordIOWriter(buf)
     for r in records:
         w.write_record(r)
-    return buf.b.getvalue()
+    return bytes(buf.data)
 
 
 def test_remote_all_parts_match_python_engine(mock_s3):
@@ -284,23 +275,14 @@ def test_cached_all_parts_coverage(tmp_path):
 
 # ------------------------------------------------- indexed recordio on s3 ----
 def test_remote_indexed_recordio_span_reader(mock_s3):
+    from dmlc_core_tpu.io.memory_io import MemoryStringStream
+
     records = [b"idx-%04d" % i for i in range(240)]
-
-    class _Buf:
-        def __init__(self):
-            self.b = io.BytesIO()
-
-        def write(self, data):
-            self.b.write(data)
-
-        def tell(self):
-            return self.b.tell()
-
-    buf = _Buf()
+    buf = MemoryStringStream()
     w = rio.IndexedRecordIOWriter(buf)
     for r in records:
         w.write_record(r)
-    mock_s3.objects[("bucket", "i/data.rec")] = buf.b.getvalue()
+    mock_s3.objects[("bucket", "i/data.rec")] = bytes(buf.data)
     index_text = "".join(f"{i} {off}\n" for i, off in enumerate(w.offsets))
     mock_s3.objects[("bucket", "i/data.idx")] = index_text.encode()
 
