@@ -167,6 +167,22 @@ def run_bench(force_cpu):
     else:
         cpu_rps = accel_rps  # vs_baseline := 1.0 — no accelerator this run
 
+    # machine-utilization anchor (r3 VERDICT weak #6): the profiled claim is
+    # that the fit is VPU-bound on the hist kernel's in-VMEM one-hot build
+    # (B*F*num_bins compare+accumulate lane-ops per level, m-independent).
+    # Model that work and the HBM bytes actually streamed, so "VPU-bound"
+    # is a checkable number: measured seconds ~= vpu_est_s >> hbm_est_s,
+    # and utilization = vpu_est_s / measured.  v5e-1 figures: 8 VPU lanes
+    # x 128 sublanes x ~0.94 GHz int32; ~819 GB/s HBM.
+    levels = accel_rounds * MAX_DEPTH
+    vpu_lane_ops = levels * N_ROWS * N_FEATURES * NUM_BINS * 2  # cmp + add
+    vpu_est_s = vpu_lane_ops / (8 * 128 * 0.94e9)
+    n_pad = 16  # min node padding; W rows per level >= 2*n_pad
+    hbm_bytes = levels * (
+        N_ROWS * N_FEATURES * 4          # bins tile stream (int32)
+        + 2 * n_pad * N_ROWS * 2 * 2     # W [2n_pad, B] bf16 write + read
+        + 2 * n_pad * N_FEATURES * NUM_BINS * 4)  # hist out
+    hbm_est_s = hbm_bytes / 819e9
     result = {
         "metric": "gbdt_hist_train_rows_per_sec_per_chip",
         "value": round(accel_rps, 1),
@@ -183,6 +199,15 @@ def run_bench(force_cpu):
             "seconds": round(accel_s, 3),
             "cpu_rows_per_sec": round(cpu_rps, 1),
             "train_acc": round(acc, 4),
+            "roofline": {
+                "vpu_onehot_est_s": round(vpu_est_s, 4),
+                "hbm_stream_est_s": round(hbm_est_s, 4),
+                "vpu_utilization_vs_measured": round(
+                    vpu_est_s / accel_s, 3) if accel_s else None,
+                "model": "levels*B*F*nbins*2 lane-ops / (8x128 lanes "
+                         "@0.94GHz); bytes: bins+W+hist per level @819GB/s "
+                         "(v5e-1)",
+            },
         },
     }
     print(JSON_TAG + json.dumps(result), flush=True)

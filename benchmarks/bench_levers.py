@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""On-chip lever measurement (r4): everything queued behind the tunnel.
+
+Runs the remaining single-chip perf levers as A/Bs and prints one line per
+measurement.  Run on the real chip (falls back to CPU with a warning):
+
+    python benchmarks/bench_levers.py [rows]
+
+1. block_rows sweep on the flagship fit (r3 found 256-4096 within noise;
+   reconfirm post-routing-fix).
+2. int8-compare probe state (r3: unsupported by this chip's Mosaic; a
+   platform upgrade would flip it and halve one-hot VPU work).
+3. dead-row diagnostic: fraction of rows sitting in finalized (sf == -1)
+   nodes per level on the flagship workload — the measured upper bound on
+   what row compaction could ever save (r4 analysis: near zero for
+   balanced depth-6 HIGGS trees; this prints the actual number).
+4. rows/sec at the requested scale (default 2M; BASELINE item 6 — the
+   headline must not be a small-working-set artifact).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def make_data(rows, f=28, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(rows, f).astype(np.float32)
+    w = rng.randn(f).astype(np.float32)
+    y = ((x @ w + 0.3 * rng.randn(rows)) > 0).astype(np.float32)
+    return x, y
+
+
+def timed_fit(model, bins, y, n=3):
+    import jax
+
+    ens, margin = model.fit_binned(bins, y)        # warm compile
+    jax.block_until_ready(margin)
+    best = 1e18
+    for _ in range(n):
+        t0 = time.perf_counter()
+        ens, margin = model.fit_binned(bins, y)
+        jax.block_until_ready(margin)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    import jax
+
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+    from dmlc_core_tpu.ops import hist_pallas
+
+    dev = jax.devices()[0]
+    print(f"device: {dev} (platform={dev.platform})")
+    if dev.platform == "cpu":
+        print("WARNING: no accelerator — numbers below are CPU, not the "
+              "lever measurements this script exists for")
+
+    # 2. i8 probe
+    print(f"pallas_supported={hist_pallas.pallas_supported()} "
+          f"i8_compares={hist_pallas.pallas_i8_supported()}")
+
+    # small flagship workload for the sweep + diagnostic
+    x, y = make_data(200_000)
+    param = GBDTParam(num_boost_round=10, max_depth=6, num_bins=256)
+    model = GBDT(param, num_feature=28)
+    model.make_bins(x[:50_000])
+    bins = np.asarray(model.bin_features(x), np.int32)
+
+    # 1. block_rows sweep — the knob is a def-time default, so each point
+    # runs in a child process with the supported env override
+    if "DMLC_TPU_HIST_BLOCK_ROWS" in os.environ:
+        s = timed_fit(model, bins, y)
+        print(f"block_rows={os.environ['DMLC_TPU_HIST_BLOCK_ROWS']}: "
+              f"{s * 1e3:.1f} ms ({200_000 * 10 / s / 1e6:.2f}M rows/s)")
+        return
+    import subprocess
+
+    for br in (256, 512, 1024, 2048, 4096):
+        env = dict(os.environ, DMLC_TPU_HIST_BLOCK_ROWS=str(br))
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__),
+                               "200000"], env=env, capture_output=True,
+                              text=True, timeout=900)
+        for line in proc.stdout.splitlines():
+            if line.startswith("block_rows="):
+                print(line)
+
+    # 3. dead-row diagnostic (host replay of the routing; no chip needed,
+    # printed here so the lever decision and the chip numbers co-locate)
+    ens, _ = model.fit_binned(bins, y)
+    sf = np.asarray(ens.split_feat)                # [T, 2**d - 1]
+    bb = np.asarray(ens.split_bin)
+    for tree in range(min(3, sf.shape[0])):
+        node = np.zeros(len(bins), np.int32)
+        dead = np.zeros(len(bins), bool)
+        fracs = []
+        for depth in range(param.max_depth):
+            off = 2 ** depth - 1
+            nf = sf[tree][off + node]
+            dead |= nf < 0
+            fracs.append(dead.mean())
+            go_right = np.where(
+                nf >= 0,
+                bins[np.arange(len(bins)), np.maximum(nf, 0)]
+                > bb[tree][off + node], False)
+            node = node * 2 + go_right.astype(np.int32)
+        print(f"tree {tree}: dead-row fraction per level "
+              f"{[f'{f:.3f}' for f in fracs]} "
+              f"(compaction upper bound = mean {np.mean(fracs):.3f})")
+
+    # 4. scaled run
+    if rows > 200_000:
+        x, y = make_data(rows)
+        model = GBDT(param, num_feature=28)
+        model.make_bins(x[:50_000])
+        bins = np.asarray(model.bin_features(x), np.int32)
+        s = timed_fit(model, bins, y, n=2)
+        print(f"scaled {rows} rows: {s * 1e3:.1f} ms "
+              f"({rows * 10 / s / 1e6:.2f}M rows/s)")
+
+
+if __name__ == "__main__":
+    main()

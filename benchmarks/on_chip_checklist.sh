@@ -66,3 +66,15 @@ for mode in (True, False):
     m.fit_with_eval(tr, ytr, ev, yev, compiled=mode)
     print(f"eval fit compiled={mode}: {time.perf_counter()-t0:.3f}s")
 EOF
+
+# ---- round 4 additions -----------------------------------------------------
+# 6. lever sweep: block_rows A/B, i8 probe, dead-row diagnostic, 2M-row scale
+#    (VERDICT r3 items 2 + 6)
+python benchmarks/bench_levers.py 2000000
+
+# 7. scaled driver-metric capture: rows/sec at 2M rows must land within ~20%
+#    of the 200k figure (headline not a small-working-set artifact)
+BENCH_ROWS=2000000 python bench.py
+
+# 8. cached + remote fast-path numbers on this host (VERDICT r3 item 3)
+python benchmarks/bench_cached.py 256 --remote

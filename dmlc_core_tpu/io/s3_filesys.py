@@ -98,7 +98,11 @@ class _S3Client:
         query = {k: str(v) for k, v in (query or {}).items()}
         path = self.base_path + ("/" + key.lstrip("/") if key else "")
         payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA
-        qs = urllib.parse.urlencode(sorted(query.items()))
+        # quote_via=quote: spaces must travel as %20, the form sign_request
+        # canonicalizes — urlencode's default '+' is signed differently and
+        # real endpoints 403 it (SignatureDoesNotMatch)
+        qs = urllib.parse.urlencode(sorted(query.items()),
+                                    quote_via=urllib.parse.quote)
         # the wire path must be the percent-encoded form (spaces/unicode in
         # keys are illegal in an HTTP request line); sign_request encodes
         # the raw path identically for the canonical URI, so wire == signed

@@ -109,19 +109,23 @@ class Stream:
         return self.read_exact(n)
 
     def write_array(self, arr: np.ndarray) -> None:
-        """uint64 element count + raw little-endian POD data (vector<T> layout)."""
+        """uint64 element count + raw little-endian POD data (vector<T>
+        layout).  LE is pinned regardless of host order (reference
+        include/dmlc/endian.h contract); on LE hosts the astype is a
+        no-copy no-op."""
         arr = np.ascontiguousarray(arr)
         CHECK(arr.dtype.kind in "iuf", f"write_array: non-POD dtype {arr.dtype}")
         self.write_u64(arr.size)
-        if arr.dtype.byteorder == ">":
-            arr = arr.astype(arr.dtype.newbyteorder("<"))
-        self.write(arr.tobytes())
+        self.write(arr.astype(arr.dtype.newbyteorder("<"),
+                              copy=False).tobytes())
 
     def read_array(self, dtype: np.dtype) -> np.ndarray:
         dtype = np.dtype(dtype)
         n = self.read_u64()
         data = self.read_exact(n * dtype.itemsize)
-        return np.frombuffer(data, dtype=dtype).copy()
+        # bytes on the wire are LE; hand back the caller's native dtype
+        return (np.frombuffer(data, dtype=dtype.newbyteorder("<"))
+                .astype(dtype, copy=False).copy())
 
     # -- adapters -------------------------------------------------------------
     def as_file(self) -> "_StreamFile":

@@ -50,7 +50,10 @@ class POD(_Spec):
     """Fixed-width scalar (reference PODHandler, serializer.h:69-77)."""
 
     def __init__(self, dtype: Any):
-        self.dtype = np.dtype(dtype)
+        # pin little-endian regardless of host order (the reference guards
+        # byte order the same way, include/dmlc/endian.h:10-17); on LE
+        # hosts this is the native dtype, so no conversion cost
+        self.dtype = np.dtype(dtype).newbyteorder("<")
         CHECK(self.dtype.kind in "iufb", f"POD spec requires numeric dtype, got {self.dtype}")
 
     def save(self, stream: Stream, value: Any) -> None:

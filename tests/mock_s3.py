@@ -62,8 +62,15 @@ def verify_sigv4(handler, body: bytes, secrets=None):
     parsed = urllib.parse.urlparse(handler.path)
     # canonical URI: S3 servers use the raw received path (no normalization)
     canon_uri = parsed.path or "/"
-    # canonical query: decoded pairs re-encoded with AWS rules, sorted
-    pairs = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+    # canonical query: decode each raw pair WITHOUT plus-to-space (real S3
+    # signs '+' as a literal plus; a client that sends '+' for a space it
+    # signed as %20 must fail here, not be normalized clean), then
+    # re-encode with AWS rules and sort
+    pairs = []
+    if parsed.query:
+        for item in parsed.query.split("&"):
+            k, _, v = item.partition("=")
+            pairs.append((urllib.parse.unquote(k), urllib.parse.unquote(v)))
     canon_query = "&".join(
         f"{_aws_quote(k)}={_aws_quote(v)}" for k, v in sorted(pairs))
     names = signed_headers.split(";")

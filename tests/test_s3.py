@@ -137,6 +137,13 @@ def test_nasty_object_keys_roundtrip(mock_s3):
     listed = {e.path.name for e in
               fs.list_directory(fsys.URI("s3://bucket/dir"))}
     assert listed == {f"/{k}" for k in keys}
+    # spaces in QUERY values (the list prefix) — signed %20 must match the
+    # wire form; '+'-encoded spaces fail real endpoints and the strict mock
+    spaced = {e.path.name for e in
+              fs.list_directory(fsys.URI("s3://bucket/dir/with space.txt"))}
+    assert spaced == set() or spaced == {"/dir/with space.txt"}
+    info = fs.get_path_info(fsys.URI("s3://bucket/dir/with space.txt"))
+    assert info.size == len(b"payload-0")
 
 
 def test_paginated_listing_follows_continuation(monkeypatch):

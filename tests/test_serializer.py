@@ -100,3 +100,22 @@ def test_truncated_read_raises():
     s.seek(0)
     with pytest.raises(Error, match="short read"):
         ser.load(s, ser.Vector(ser.POD(np.float64)))
+
+
+def test_endianness_pinned_little():
+    """The wire format is LE regardless of the dtype's (or host's) byte
+    order — the reference's endian.h contract.  Big-endian inputs are the
+    host-order proxy testable on an LE machine."""
+    s = MemoryStringStream()
+    ser.save(s, 0x01020304, ser.POD(np.dtype(">i4")))
+    assert bytes(s.data) == b"\x04\x03\x02\x01"       # LE on the wire
+    s.seek(0)
+    assert ser.load(s, ser.POD(np.dtype(">i4"))) == 0x01020304
+
+    s = MemoryStringStream()
+    arr = np.array([1, 2], dtype=">u2")
+    ser.save(s, arr, ser.Vector(ser.POD(">u2")))
+    assert bytes(s.data) == (2).to_bytes(8, "little") + b"\x01\x00\x02\x00"
+    s.seek(0)
+    out = ser.load(s, ser.Vector(ser.POD(">u2")))
+    assert list(out) == [1, 2]
