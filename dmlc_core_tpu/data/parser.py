@@ -102,13 +102,35 @@ class TextParserBase(ParserImpl):
         return None
 
     def parse_next_blocks(self) -> Optional[List[RowBlockContainer]]:
-        chunk = self._source.next_chunk()
-        if chunk is None:
-            return None
-        self._bytes_read += len(chunk)
-        native = self.parse_chunk_native(chunk)
-        if native is not None:
-            return [native]
+        # zero-copy fast path: a native split hands an (addr, len) view
+        # over its resident chunk buffer and the native parser reads it in
+        # place — no Python bytes between the two C++ stages.  Only taken
+        # when native parsing is certain (available() => every text
+        # parser's parse_chunk_native succeeds), because the numpy
+        # fallback needs a real bytes object.
+        from dmlc_core_tpu import native_bridge
+
+        view_fn = getattr(self._source, "next_chunk_view", None)
+        if view_fn is not None and native_bridge.available():
+            view = view_fn()
+            if view is None:
+                return None
+            self._bytes_read += view[1]
+            native = self.parse_chunk_native(view)
+            if native is not None:
+                return [native]
+            # a parser without a native path: materialize and fall through
+            import ctypes
+
+            chunk = ctypes.string_at(*view)
+        else:
+            chunk = self._source.next_chunk()
+            if chunk is None:
+                return None
+            self._bytes_read += len(chunk)
+            native = self.parse_chunk_native(chunk)
+            if native is not None:
+                return [native]
         ranges = self._split_ranges(chunk, self._nthread)
         if self._pool is None or len(ranges) <= 1:
             return [self.parse_block(r) for r in ranges]

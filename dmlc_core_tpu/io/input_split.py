@@ -1201,6 +1201,14 @@ class NativeLineSplitter(InputSplit):
         except OSError as exc:
             _raise_native_error(self._adapter, exc)
 
+    def next_chunk_view(self):
+        """Zero-copy ``(addr, len)`` chunk view, valid until the next call
+        on this split (consumed in place by the native parsers)."""
+        try:
+            return self._native.next_chunk_view()
+        except OSError as exc:
+            _raise_native_error(self._adapter, exc)
+
     def next_record(self) -> Optional[memoryview]:
         return _next_record_from_chunks(self, self.next_chunk,
                                         self._extract)
@@ -1263,10 +1271,10 @@ class NativeCachedSplitter(InputSplit):
             self._at_end = False
         self._cursor = ChunkCursor()
 
-    def next_chunk(self) -> Optional[bytes]:
+    def _next_chunk_impl(self, preproc_fetch, replay_fetch):
         if self._replay is None:
             try:
-                chunk = self._native.next_chunk()
+                chunk = preproc_fetch()
             except OSError as exc:
                 _raise_native_error(self._adapter, exc)
             if chunk is None:
@@ -1276,10 +1284,22 @@ class NativeCachedSplitter(InputSplit):
             return chunk
         if self._at_end:
             return None
-        chunk = self._replay.next_chunk()
+        chunk = replay_fetch()
         if chunk is None:
             self._at_end = True
         return chunk
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._next_chunk_impl(
+            lambda: self._native.next_chunk(),
+            lambda: self._replay.next_chunk())
+
+    def next_chunk_view(self):
+        """Zero-copy ``(addr, len)`` chunk view, valid until the next call
+        on this split."""
+        return self._next_chunk_impl(
+            lambda: self._native.next_chunk_view(),
+            lambda: self._replay.next_chunk_view())
 
     def next_record(self) -> Optional[memoryview]:
         return _next_record_from_chunks(self, self.next_chunk,

@@ -134,10 +134,25 @@ def _ptr(arr: Optional[np.ndarray]):
     return arr.ctypes.data_as(ctypes.c_void_p)
 
 
-def _parse_sparse(fn_name: str, data: bytes, nthread: int):
+def _as_data_ptr(data):
+    """bytes -> (c_char_p, len); (addr, len) -> zero-copy pointer pass.
+
+    The (addr, len) form is the native-split fast path: the chunk stays in
+    the split handle's buffer (valid until its next call) and the parser
+    reads it in place — no Python bytes materialization between the C++
+    split engine and the C++ parser.
+    """
+    if isinstance(data, tuple):
+        addr, length = data
+        return ctypes.c_char_p(addr), length
+    return data, len(data)
+
+
+def _parse_sparse(fn_name: str, data, nthread: int):
     lib = _load()
     assert lib is not None
-    handle = getattr(lib, fn_name)(data, len(data), nthread)
+    ptr, length = _as_data_ptr(data)
+    handle = getattr(lib, fn_name)(ptr, length, nthread)
     try:
         n_rows = ctypes.c_int64()
         nnz = ctypes.c_int64()
@@ -163,30 +178,34 @@ def _parse_sparse(fn_name: str, data: bytes, nthread: int):
         lib.dmlc_tpu_result_free(handle)
 
 
-def parse_libsvm(data: bytes, nthread: int = 4):
-    """Chunk -> (offset, label, weight|None, index, value|None)."""
+def parse_libsvm(data, nthread: int = 4):
+    """Chunk (bytes or zero-copy ``(addr, len)``) ->
+    (offset, label, weight|None, index, value|None)."""
     offset, label, weight, index, _, value = _parse_sparse(
         "dmlc_tpu_parse_libsvm", data, nthread)
     return offset, label, weight, index, value
 
 
-def parse_libfm(data: bytes, nthread: int = 4):
-    """Chunk -> (offset, label, weight|None, index, field, value)."""
+def parse_libfm(data, nthread: int = 4):
+    """Chunk (bytes or zero-copy ``(addr, len)``) ->
+    (offset, label, weight|None, index, field, value)."""
     offset, label, weight, index, field, value = _parse_sparse(
         "dmlc_tpu_parse_libfm", data, nthread)
     return offset, label, weight, index, field, value
 
 
-def parse_csv(data: bytes, nthread: int = 4,
+def parse_csv(data, nthread: int = 4,
               missing: float = 0.0) -> np.ndarray:
-    """Chunk -> dense [n_rows, n_cols] float32.
+    """Chunk (bytes or zero-copy ``(addr, len)``) -> dense [n_rows, n_cols]
+    float32.
 
     ``missing`` fills empty cells (reference strtof-on-empty parity = 0.0;
     NaN for sparsity-aware training).
     """
     lib = _load()
     assert lib is not None
-    handle = lib.dmlc_tpu_parse_csv(data, len(data), nthread,
+    ptr, length = _as_data_ptr(data)
+    handle = lib.dmlc_tpu_parse_csv(ptr, length, nthread,
                                     ctypes.c_float(missing))
     try:
         n_rows = ctypes.c_int64()
@@ -430,6 +449,15 @@ class NativeLineSplit:
         self._lib.dmlc_tpu_lsplit_hint(self._require_open(), chunk_size)
 
     def next_chunk(self):
+        view = self.next_chunk_view()
+        if view is None:
+            return None
+        return ctypes.string_at(*view)
+
+    def next_chunk_view(self):
+        """Zero-copy ``(addr, len)`` over the next chunk — valid until the
+        next call on this handle (the parser fast path consumes it in
+        place before popping again)."""
         ptr = ctypes.c_char_p()
         n = self._lib.dmlc_tpu_lsplit_next_chunk(self._require_open(),
                                                  ctypes.byref(ptr))
@@ -437,7 +465,7 @@ class NativeLineSplit:
             self._check()
         if n <= 0:
             return None
-        return ctypes.string_at(ptr, n)
+        return ctypes.cast(ptr, ctypes.c_void_p).value, n
 
     def close(self) -> None:
         if self._handle is not None:
@@ -547,6 +575,13 @@ class NativeCacheReplay:
         self._check()
 
     def next_chunk(self):
+        view = self.next_chunk_view()
+        if view is None:
+            return None
+        return ctypes.string_at(*view)
+
+    def next_chunk_view(self):
+        """Zero-copy ``(addr, len)``, valid until the next call."""
         ptr = ctypes.c_char_p()
         n = self._lib.dmlc_tpu_creplay_next_chunk(self._require_open(),
                                                   ctypes.byref(ptr))
@@ -554,7 +589,7 @@ class NativeCacheReplay:
             self._check()
         if n <= 0:
             return None
-        return ctypes.string_at(ptr, n)
+        return ctypes.cast(ptr, ctypes.c_void_p).value, n
 
     def close(self) -> None:
         if self._handle is not None:

@@ -18,6 +18,7 @@
 #include <vector>
 
 #include "dmlc_tpu/input_split.h"
+#include "dmlc_tpu/io.h"
 #include "dmlc_tpu/parameter.h"
 #include "dmlc_tpu/registry.h"
 
@@ -63,11 +64,75 @@ int64_t FileSize(const char *path) {
   return static_cast<int64_t>(st.st_size);
 }
 
+// a model-checkpoint-shaped nested structure for the serializer interop:
+// the same layout Python writes with
+// Pair(Map(Str, Vector(POD(f4))), Vector(Pair(Str, POD(i8))))
+using Blob = std::pair<std::map<std::string, std::vector<float>>,
+                       std::vector<std::pair<std::string, int64_t>>>;
+
+Blob MakeBlob() {
+  Blob b;
+  b.first["weights"] = {1.5f, -2.25f, 0.0f};
+  b.first["bias"] = {0.125f};
+  b.second = {{"rounds", 10}, {"depth", 6}};
+  return b;
+}
+
+// --serialize <out>: write the blob; --deserialize <in>: read + print a
+// digest Python can assert on (tests/test_cpp_consumer.py interop)
+int SerializeMain(const char *mode, const char *path) {
+  if (std::strcmp(mode, "--serialize") == 0) {
+    dmlc_tpu::FileStream fo(path, "wb");
+    dmlc_tpu::Save(&fo, MakeBlob());
+    std::printf("serialized ok\n");
+    return 0;
+  }
+  if (std::strcmp(mode, "--deserialize") != 0) {
+    std::fprintf(stderr, "unknown flag: %s\n", mode);
+    return 2;
+  }
+  dmlc_tpu::FileStream fi(path, "rb");
+  Blob b;
+  if (!dmlc_tpu::Load(&fi, &b)) {
+    std::fprintf(stderr, "deserialize failed\n");
+    return 1;
+  }
+  double wsum = 0;
+  for (const auto &kv : b.first) {
+    for (float v : kv.second) wsum += v;
+  }
+  std::printf("maps=%zu wsum=%.4f", b.first.size(), wsum);
+  for (const auto &p : b.second) {
+    std::printf(" %s=%lld", p.first.c_str(),
+                static_cast<long long>(p.second));
+  }
+  std::printf("\n");
+  // round-trip check: re-serialize must be byte-identical to the WHOLE
+  // input (one extra byte read catches trailing garbage)
+  dmlc_tpu::MemoryStream ms;
+  dmlc_tpu::Save(&ms, b);
+  dmlc_tpu::FileStream fi2(path, "rb");
+  std::string orig(ms.buffer().size() + 1, '\0');
+  size_t got = fi2.Read(&orig[0], orig.size());
+  orig.resize(got);
+  if (orig != ms.buffer()) {
+    std::fprintf(stderr, "round-trip bytes differ\n");
+    return 1;
+  }
+  std::printf("roundtrip ok\n");
+  return 0;
+}
+
 }  // namespace
 
 int main(int argc, char **argv) {
+  if (argc == 3 && argv[1][0] == '-') {
+    return SerializeMain(argv[1], argv[2]);
+  }
   if (argc < 3) {
-    std::fprintf(stderr, "usage: %s <file.libsvm> <nparts>\n", argv[0]);
+    std::fprintf(stderr, "usage: %s <file.libsvm> <nparts> | "
+                         "--serialize <out> | --deserialize <in>\n",
+                 argv[0]);
     return 2;
   }
   const char *path = argv[1];

@@ -1,0 +1,268 @@
+// Header-only byte streams + typed serialization for C++ consumers —
+// the native face of the framework's serialization layer (capability
+// parity with reference include/dmlc/io.h:29-126 Stream/Serializable and
+// include/dmlc/serializer.h:35-381; re-designed as C++17 overload
+// resolution instead of the reference's C++11 handler templates).
+//
+// The wire format is the framework contract shared with the Python layer
+// (dmlc_core_tpu/serializer.py): POD scalars raw little-endian (pinned on
+// any host order — reference include/dmlc/endian.h), strings and vectors
+// as u64-LE element count + payload, maps as u64-LE count + key/value
+// pairs, pairs as first-then-second.  Blobs written here load in Python
+// and vice versa (proven by tests/test_cpp_consumer.py interop).
+#ifndef DMLC_TPU_IO_H_
+#define DMLC_TPU_IO_H_
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+namespace dmlc_tpu {
+
+// ---- streams ---------------------------------------------------------------
+
+class Stream {
+ public:
+  virtual ~Stream() = default;
+  // bytes actually read (short only at end of data)
+  virtual size_t Read(void *ptr, size_t size) = 0;
+  virtual void Write(const void *ptr, size_t size) = 0;
+};
+
+class MemoryStream : public Stream {
+ public:
+  MemoryStream() = default;
+  explicit MemoryStream(std::string data) : buffer_(std::move(data)) {}
+
+  size_t Read(void *ptr, size_t size) override {
+    size_t n = std::min(size, buffer_.size() - pos_);
+    std::memcpy(ptr, buffer_.data() + pos_, n);
+    pos_ += n;
+    return n;
+  }
+
+  void Write(const void *ptr, size_t size) override {
+    buffer_.append(static_cast<const char *>(ptr), size);
+  }
+
+  void Rewind() { pos_ = 0; }
+  const std::string &buffer() const { return buffer_; }
+
+ private:
+  std::string buffer_;
+  size_t pos_ = 0;
+};
+
+class FileStream : public Stream {
+ public:
+  FileStream(const char *path, const char *mode) {
+    fp_ = std::fopen(path, mode);
+    if (!fp_) throw std::runtime_error(std::string("cannot open ") + path);
+  }
+  ~FileStream() override {
+    if (fp_) std::fclose(fp_);
+  }
+  FileStream(const FileStream &) = delete;
+  FileStream &operator=(const FileStream &) = delete;
+
+  size_t Read(void *ptr, size_t size) override {
+    return std::fread(ptr, 1, size, fp_);
+  }
+  void Write(const void *ptr, size_t size) override {
+    if (std::fwrite(ptr, 1, size, fp_) != size) {
+      throw std::runtime_error("short write");
+    }
+  }
+
+ private:
+  std::FILE *fp_ = nullptr;
+};
+
+// ---- little-endian pinning -------------------------------------------------
+
+namespace io_detail {
+
+constexpr bool kHostBigEndian =
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_BIG_ENDIAN__
+    true;
+#else
+    false;
+#endif
+
+template <typename T>
+inline T ByteSwap(T v) {
+  unsigned char *p = reinterpret_cast<unsigned char *>(&v);
+  for (size_t i = 0; i < sizeof(T) / 2; ++i) {
+    std::swap(p[i], p[sizeof(T) - 1 - i]);
+  }
+  return v;
+}
+
+template <typename T>
+inline T ToLE(T v) {
+  return kHostBigEndian ? ByteSwap(v) : v;
+}
+template <typename T>
+inline T FromLE(T v) {
+  return kHostBigEndian ? ByteSwap(v) : v;
+}
+
+}  // namespace io_detail
+
+// ---- typed serialization ---------------------------------------------------
+// Save(stream, value) / Load(stream, &value) overload sets covering POD,
+// std::string, std::vector<T>, std::map<K, V>, std::pair<A, B>, and any
+// nesting of those; a class with Save(Stream*)/Load(Stream*) members
+// participates via the generic overload (the reference's Serializable).
+
+template <typename T>
+inline std::enable_if_t<std::is_arithmetic_v<T>> Save(Stream *s, const T &v) {
+  T le = io_detail::ToLE(v);
+  s->Write(&le, sizeof(T));
+}
+
+template <typename T>
+inline std::enable_if_t<std::is_arithmetic_v<T>, bool> Load(Stream *s, T *v) {
+  T le;
+  if (s->Read(&le, sizeof(T)) != sizeof(T)) return false;
+  *v = io_detail::FromLE(le);
+  return true;
+}
+
+inline void Save(Stream *s, const std::string &v) {
+  Save(s, static_cast<uint64_t>(v.size()));
+  s->Write(v.data(), v.size());
+}
+
+namespace io_detail {
+
+// grow-as-you-read payload fill: a corrupt/garbage u64 count must yield
+// Load() == false, never a std::length_error/bad_alloc escaping the bool
+// contract — so never trust the count with one up-front allocation
+template <typename Container>
+inline bool ReadPayload(Stream *s, Container *v, uint64_t n) {
+  constexpr uint64_t kStep = 64 << 20;  // bytes per growth step
+  using Elem = typename Container::value_type;
+  if (n > UINT64_MAX / sizeof(Elem)) return false;  // count overflow
+  uint64_t total = n * sizeof(Elem);
+  uint64_t got = 0;
+  while (got < total) {
+    uint64_t want = std::min(kStep, total - got);
+    try {
+      v->resize(static_cast<size_t>((got + want) / sizeof(Elem)));
+    } catch (...) {
+      return false;
+    }
+    char *dst = reinterpret_cast<char *>(&(*v)[0]) + got;
+    if (s->Read(dst, static_cast<size_t>(want)) != want) return false;
+    got += want;
+  }
+  return true;
+}
+
+}  // namespace io_detail
+
+inline bool Load(Stream *s, std::string *v) {
+  uint64_t n;
+  if (!Load(s, &n)) return false;
+  v->clear();
+  return io_detail::ReadPayload(s, v, n);
+}
+
+template <typename A, typename B>
+void Save(Stream *s, const std::pair<A, B> &v);
+template <typename A, typename B>
+bool Load(Stream *s, std::pair<A, B> *v);
+template <typename K, typename V>
+void Save(Stream *s, const std::map<K, V> &v);
+template <typename K, typename V>
+bool Load(Stream *s, std::map<K, V> *v);
+
+template <typename T>
+void Save(Stream *s, const std::vector<T> &v) {
+  Save(s, static_cast<uint64_t>(v.size()));
+  if constexpr (std::is_arithmetic_v<T> && !io_detail::kHostBigEndian) {
+    // bulk copy (reference PODVectorHandler); already little-endian
+    s->Write(v.data(), v.size() * sizeof(T));
+  } else {
+    for (const T &item : v) Save(s, item);
+  }
+}
+
+template <typename T>
+bool Load(Stream *s, std::vector<T> *v) {
+  uint64_t n;
+  if (!Load(s, &n)) return false;
+  v->clear();
+  if constexpr (std::is_arithmetic_v<T> && !io_detail::kHostBigEndian) {
+    return io_detail::ReadPayload(s, v, n);
+  } else {
+    // element-wise: no up-front reserve by the untrusted count — a short
+    // stream fails on its first missing element instead of pre-allocating
+    for (uint64_t i = 0; i < n; ++i) {
+      T item{};
+      if (!Load(s, &item)) return false;
+      v->push_back(std::move(item));
+    }
+    return true;
+  }
+}
+
+template <typename A, typename B>
+void Save(Stream *s, const std::pair<A, B> &v) {
+  Save(s, v.first);
+  Save(s, v.second);
+}
+
+template <typename A, typename B>
+bool Load(Stream *s, std::pair<A, B> *v) {
+  return Load(s, &v->first) && Load(s, &v->second);
+}
+
+template <typename K, typename V>
+void Save(Stream *s, const std::map<K, V> &v) {
+  Save(s, static_cast<uint64_t>(v.size()));
+  for (const auto &kv : v) {
+    Save(s, kv.first);
+    Save(s, kv.second);
+  }
+}
+
+template <typename K, typename V>
+bool Load(Stream *s, std::map<K, V> *v) {
+  uint64_t n;
+  if (!Load(s, &n)) return false;
+  v->clear();
+  for (uint64_t i = 0; i < n; ++i) {
+    K key{};
+    V val{};
+    if (!Load(s, &key) || !Load(s, &val)) return false;
+    v->emplace(std::move(key), std::move(val));
+  }
+  return true;
+}
+
+// user classes with Save/Load members (the reference's Serializable /
+// SaveLoadClassHandler)
+template <typename T>
+inline std::enable_if_t<!std::is_arithmetic_v<T>> Save(Stream *s,
+                                                       const T &v) {
+  v.Save(s);
+}
+
+template <typename T>
+inline std::enable_if_t<!std::is_arithmetic_v<T>, bool> Load(Stream *s,
+                                                             T *v) {
+  return v->Load(s);
+}
+
+}  // namespace dmlc_tpu
+
+#endif  // DMLC_TPU_IO_H_
