@@ -1,0 +1,23 @@
+"""The docs pipeline builds clean (reference doc/conf.py + Doxyfile analog;
+a module import failure = doc rot = test failure)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_docs_build(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "build_docs.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=600,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr
+    assert (tmp_path / "index.html").exists()
+    names = os.listdir(tmp_path)
+    assert sum(n.startswith("api_") for n in names) > 50
+    assert "guide.md" in names
+    index = (tmp_path / "index.html").read_text()
+    assert "api_dmlc_core_tpu.models.gbdt.html" in index
