@@ -2,8 +2,8 @@
 TPU way (SURVEY.md §2.9) — rows sharded across PROCESSES on a global mesh,
 histogram aggregation compiled to collectives by GSPMD over jax.distributed.
 
-The e2e launches 2 workers via the local tracker backend; each owns half the
-rows (4 virtual CPU devices per process), builds identical bin boundaries
+The e2e launches 2 or 4 workers via the local tracker backend; each owns its
+row shard (4 virtual CPU devices per process), builds identical bin boundaries
 through the distributed quantile sketch, fits on globally-sharded arrays, and
 must produce the SAME ensemble on every rank (it is one SPMD program — rank
 divergence would mean the collective path is broken).
@@ -28,8 +28,8 @@ from dmlc_core_tpu import collective
 collective.init()
 rank = collective.get_rank()
 world = collective.get_world_size()
-assert world == 2, world
-assert len(jax.devices()) == 8, jax.devices()   # 4 local x 2 processes
+assert world == int(os.environ["EXPECT_WORLD"]), world
+assert len(jax.devices()) == 4 * world, jax.devices()  # 4 local per process
 
 from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
 from dmlc_core_tpu.parallel.mesh import (data_sharding, make_mesh,
@@ -55,7 +55,7 @@ model.make_bins(x[lo:lo + half], comm=collective)
 bins_local = np.asarray(model.bin_features(x[lo:lo + half]), np.int32)
 y_local = y[lo:lo + half]
 
-mesh = make_mesh()          # one axis over all 8 global devices
+mesh = make_mesh()          # one axis over all 4*world global devices
 sh2 = data_sharding(mesh, ndim=2)
 sh1 = data_sharding(mesh, ndim=1)
 gbins = jax.make_array_from_process_local_data(sh2, bins_local, (B, F))
@@ -77,16 +77,20 @@ collective.finalize()
 
 
 @pytest.mark.slow
-def test_distributed_gbdt_fit_agrees_across_ranks(tmp_path):
-    proc = run_tracker_workers(tmp_path, DP_WORKER, 2)
+@pytest.mark.parametrize("nworkers", [2, 4])
+def test_distributed_gbdt_fit_agrees_across_ranks(tmp_path, nworkers):
+    proc = run_tracker_workers(tmp_path, DP_WORKER, nworkers,
+                               env_extra={"EXPECT_WORLD": str(nworkers)})
     assert proc.returncode == 0, proc.stderr[-4000:]
     r0 = np.load(tmp_path / "rank0.npz")
-    r1 = np.load(tmp_path / "rank1.npz")
-    # distributed sketch: identical boundaries from different shards
-    np.testing.assert_array_equal(r0["boundaries"], r1["boundaries"])
-    # one SPMD program: both ranks hold the same ensemble
-    np.testing.assert_array_equal(r0["sf"], r1["sf"])
-    np.testing.assert_allclose(r0["lv"], r1["lv"], rtol=1e-5, atol=1e-6)
+    for rank in range(1, nworkers):
+        rn = np.load(tmp_path / f"rank{rank}.npz")
+        # distributed sketch: identical boundaries from different shards
+        np.testing.assert_array_equal(r0["boundaries"], rn["boundaries"])
+        # one SPMD program: every rank holds the same ensemble
+        np.testing.assert_array_equal(r0["sf"], rn["sf"])
+        np.testing.assert_allclose(r0["lv"], rn["lv"], rtol=1e-5,
+                                   atol=1e-6)
     # and it actually learned the separable problem
     assert float(r0["acc"]) > 0.9, float(r0["acc"])
 

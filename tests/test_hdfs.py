@@ -112,6 +112,32 @@ def test_input_split_over_hdfs(hdfs_root):
     assert got == lines
 
 
+def test_native_engine_over_hdfs(hdfs_root, monkeypatch):
+    """The C++ chunking engine serves hdfs:// through the read-at callback
+    (DMLC_TPU_NATIVE_REMOTE opt-in) — a second, structurally different
+    FileSystem implementation behind the same _ReadAtAdapter as mock-S3."""
+    from dmlc_core_tpu import native_bridge
+
+    if not native_bridge.lsplit_available():
+        pytest.skip("native core unavailable")
+    monkeypatch.setenv("DMLC_TPU_NATIVE_REMOTE", "1")
+    tmp_path, u, _ = hdfs_root
+    lines = [b"n-%d" % i for i in range(400)]
+    (tmp_path / "n.txt").write_bytes(b"\n".join(lines) + b"\n")
+    from dmlc_core_tpu.io.input_split import (NativeLineSplitter,
+                                              create_input_split)
+
+    got = []
+    for part in range(3):
+        split = create_input_split(u("n.txt"), part, 3, "text")
+        if part == 0:
+            assert isinstance(split, NativeLineSplitter)
+            assert split._adapter is not None     # really on the callback
+        got += [bytes(r) for r in iter(split.next_record, None)]
+        split.close()
+    assert got == lines
+
+
 def test_recordio_over_hdfs(hdfs_root):
     """RecordIO writer/reader through hdfs:// streams (checkpoint-shaped IO:
     Stream::Create('hdfs://...') + Serializable, SURVEY §3.5)."""
