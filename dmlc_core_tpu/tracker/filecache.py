@@ -30,7 +30,8 @@ import zipfile
 from typing import Dict, List, Tuple
 
 __all__ = ["collect_job_files", "stage_job_dir", "files_env",
-           "prepare_shipping", "split_spec_item", "extract_archive_atomic"]
+           "prepare_shipping", "prepare_scp_shipping", "wrap_launcher_cmd",
+           "split_spec_item", "extract_archive_atomic"]
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
 
@@ -159,5 +160,24 @@ def prepare_shipping(opts, wrap_launcher: bool = False,
     files, archives, command = collect_job_files(opts)
     env = files_env(files, archives)
     if wrap_launcher and (files or archives):
-        command = ["python", "-m", "dmlc_core_tpu.tracker.launcher"] + command
+        command = wrap_launcher_cmd(command)
     return env, command, files, archives
+
+
+def wrap_launcher_cmd(command: List[str]) -> List[str]:
+    """Route a task command through the container-side launcher (which
+    materializes DMLC_JOB_FILES / unpacks DMLC_JOB_ARCHIVES)."""
+    return ["python", "-m", "dmlc_core_tpu.tracker.launcher"] + list(command)
+
+
+def prepare_scp_shipping(opts):
+    """The ssh-style backends' ship-prep (ssh + tpu-vm host-file path):
+    returns ``(ship_env, command, scp_specs, archives)`` where
+    ``scp_specs`` is every file spec plus each archive zip under its
+    basename (the form the remote unpack prelude expects)."""
+    ship_env, command, files, archives = prepare_shipping(opts)
+    scp_specs = list(files)
+    for item in archives:
+        src, _ = split_spec_item(item, archive=True)
+        scp_specs.append(f"{src}#{os.path.basename(src)}")
+    return ship_env, command, scp_specs, archives

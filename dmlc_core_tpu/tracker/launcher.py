@@ -60,6 +60,7 @@ def main(argv=None) -> int:
     env = os.environ
     cwd = env.get("DMLC_JOB_CWD")
     if cwd:
+        os.makedirs(cwd, exist_ok=True)   # per-job sandboxes (tpu-vm)
         os.chdir(cwd)
     materialize_files(env.get("DMLC_JOB_FILES", ""))
     unpack_archives(env.get("DMLC_JOB_ARCHIVES", ""))

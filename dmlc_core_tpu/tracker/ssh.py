@@ -122,15 +122,9 @@ def submit(opts) -> None:
 
     # file shipping: cached files + archives ride next to the rsync; the
     # command is rewritten to ./basename only when shipping is active
-    from dmlc_core_tpu.tracker.filecache import (prepare_shipping,
-                                                 split_spec_item)
+    from dmlc_core_tpu.tracker.filecache import prepare_scp_shipping
 
-    _, command, shipped, archives = prepare_shipping(opts)
-    # the archive zips themselves travel by scp under their basenames
-    shipped = shipped + [
-        f"{split_spec_item(a, archive=True)[0]}"
-        f"#{os.path.basename(split_spec_item(a, archive=True)[0])}"
-        for a in archives]
+    _, command, shipped, archives = prepare_scp_shipping(opts)
     prelude = _unpack_prelude(archives)
 
     def fun_submit(envs: Dict[str, str]) -> None:

@@ -24,6 +24,7 @@ import logging
 import os
 import subprocess
 import threading
+import uuid
 from typing import Dict
 
 from dmlc_core_tpu.tracker.submit import submit_job
@@ -53,6 +54,18 @@ def _gcloud_cmd(env: Dict[str, str], command) -> list:
 
 
 def submit(opts) -> None:
+    # file shipping (opt-in via --files/--archives): host-file path ships
+    # by scp like the ssh backend; the gcloud path exports the
+    # DMLC_JOB_FILES/ARCHIVES contract and wraps the command in the
+    # launcher, which materializes from host-visible sources (e.g. the
+    # GCS-fused paths TPU-VMs mount)
+    from dmlc_core_tpu.tracker.filecache import (prepare_scp_shipping,
+                                                 wrap_launcher_cmd)
+    from dmlc_core_tpu.tracker.ssh import _unpack_prelude, ship_files
+
+    ship_env, command, shipped, archives = prepare_scp_shipping(opts)
+    prelude = _unpack_prelude(archives)
+
     def fun_submit(envs: Dict[str, str]) -> None:
         base_env = dict(envs)
         for key in FORWARD_ENV:
@@ -62,14 +75,17 @@ def submit(opts) -> None:
             hosts = parse_host_file(opts.host_file, opts.ssh_port)
             assert len(hosts) >= opts.num_workers, \
                 "host file has fewer hosts than --num-workers"
+            workdir = opts.sync_dst_dir or "."
+            for host, port in set(hosts[:opts.num_workers]):
+                ship_files(shipped, host, port, workdir)
             threads = []
             for taskid in range(opts.num_workers):
                 host, port = hosts[taskid]
                 env = dict(base_env)
                 env["DMLC_ROLE"] = "worker"
                 env["DMLC_TASK_ID"] = str(taskid)
-                cmd = _ssh_command(host, port, env,
-                                   opts.sync_dst_dir or ".", opts.command)
+                cmd = _ssh_command(host, port, env, workdir, command,
+                                   prelude=prelude)
                 t = threading.Thread(target=subprocess.check_call, args=(cmd,),
                                      daemon=True)
                 t.start()
@@ -82,6 +98,16 @@ def submit(opts) -> None:
             # DMLC_TASK_ID export itself.
             env = dict(base_env)
             env["DMLC_ROLE"] = "worker"
-            subprocess.check_call(_gcloud_cmd(env, opts.command))
+            gcmd = command
+            if ship_env:
+                env.update(ship_env)
+                # the gcloud ssh session lands in the VM user's persistent
+                # home dir — materializing there would serve STALE files on
+                # resubmit (skip-if-exists semantics); give every job its
+                # own cwd, which the launcher creates and chdirs into
+                env["DMLC_JOB_CWD"] = (f"dmlc-jobs/{opts.jobname}-"
+                                       f"{uuid.uuid4().hex[:8]}")
+                gcmd = wrap_launcher_cmd(command)
+            subprocess.check_call(_gcloud_cmd(env, gcmd))
 
     submit_job(opts, fun_submit, wait=False)

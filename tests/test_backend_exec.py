@@ -267,3 +267,47 @@ def test_tpu_vm_backend_gcloud_path(fake_cluster, monkeypatch):
     tpu_vm.submit(opts)
     # per-host identity came from TPU_WORKER_ID through the env contract
     _assert_ranks(tmp_path, 2, "tpu-vm")
+
+
+def test_tpu_vm_gcloud_path_ships_files(fake_cluster, monkeypatch):
+    """--files on the gcloud path: the launcher materializes the shipped
+    file into each task's cwd (host-visible source, e.g. a mounted GCS
+    path) and the auto-cached worker token is rewritten."""
+    tmp_path, _ = fake_cluster
+    gcloud = tmp_path / "bin" / "gcloud"
+    gcloud.write_text(FAKE_GCLOUD)
+    gcloud.chmod(gcloud.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("TPU_NAME", "fake-slice")
+    monkeypatch.setenv("FAKE_TPU_HOSTS", "2")
+    payload = tmp_path / "manifest.txt"
+    payload.write_text("shipped-manifest\n")
+    rundir = tmp_path / "rundir"
+    rundir.mkdir()
+    monkeypatch.chdir(rundir)   # tasks run here; source sits elsewhere
+    reader = tmp_path / "read_manifest.py"
+    reader.write_text(
+        "import os\n"
+        "tid = os.environ['DMLC_TASK_ID']\n"
+        "body = open('manifest.txt').read().strip()\n"
+        "open(os.environ['RESULT_DIR'] + f'/ship{tid}.out', 'w')"
+        ".write(body)\n")
+    from dmlc_core_tpu.tracker import tpu_vm
+
+    opts = get_opts(["--cluster", "tpu-vm", "--num-workers", "2",
+                     "--files", str(payload), "--",
+                     sys.executable, str(reader)])
+    tpu_vm.submit(opts)
+    for tid in range(2):
+        assert (tmp_path / f"ship{tid}.out").read_text() == \
+            "shipped-manifest"
+    # resubmit with an EDITED payload: per-job cwds mean no stale copy
+    # from the previous run can be served (skip-if-exists materialization
+    # in a persistent home dir was the hazard)
+    payload.write_text("edited-manifest\n")
+    opts = get_opts(["--cluster", "tpu-vm", "--num-workers", "2",
+                     "--files", str(payload), "--",
+                     sys.executable, str(reader)])
+    tpu_vm.submit(opts)
+    for tid in range(2):
+        assert (tmp_path / f"ship{tid}.out").read_text() == \
+            "edited-manifest"
