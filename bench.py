@@ -40,11 +40,32 @@ CPU_ROUNDS = int(os.environ.get("BENCH_CPU_ROUNDS", 2))
 ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 900))
 # Budget for the cheap "can the accelerator backend even init?" probe.
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", 300))
+# Each stage's result is persisted here the instant it completes, so a
+# mid-run tunnel wedge can't zero the evidence already gathered (the r3/r4
+# failure mode: one hang late in the run -> whole capture lost).
+STAGE_DIR = os.environ.get("BENCH_STAGE_DIR", "")
 JSON_TAG = "DMLC_BENCH_JSON:"
 # __file__ is undefined when this source is exec'd (e.g. via python -c); fall
 # back to the canonical repo-root location so the re-exec driver still works.
 SCRIPT_PATH = os.path.abspath(
     globals().get("__file__", os.path.join(os.getcwd(), "bench.py")))
+
+
+def persist_stage(name, payload):
+    """Write one stage's result to its own file immediately (wedge-proofing:
+    partial evidence survives if a later stage hangs the run)."""
+    if not STAGE_DIR:
+        return
+    try:
+        os.makedirs(STAGE_DIR, exist_ok=True)
+        path = os.path.join(STAGE_DIR, f"{name}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"stage": name, "time": time.time(), **payload}, f,
+                      indent=1)
+        os.replace(tmp, path)
+    except OSError as e:
+        print(f"stage persist failed for {name}: {e}", file=sys.stderr)
 
 
 def force_cpu_backend():
@@ -174,15 +195,29 @@ def run_bench(force_cpu):
     # is a checkable number: measured seconds ~= vpu_est_s >> hbm_est_s,
     # and utilization = vpu_est_s / measured.  v5e-1 figures: 8 VPU lanes
     # x 128 sublanes x ~0.94 GHz int32; ~819 GB/s HBM.
-    levels = accel_rounds * MAX_DEPTH
-    vpu_lane_ops = levels * N_ROWS * N_FEATURES * NUM_BINS * 2  # cmp + add
-    vpu_est_s = vpu_lane_ops / (8 * 128 * 0.94e9)
-    n_pad = 16  # min node padding; W rows per level >= 2*n_pad
-    hbm_bytes = levels * (
-        N_ROWS * N_FEATURES * 4          # bins tile stream (int32)
-        + 2 * n_pad * N_ROWS * 2 * 2     # W [2n_pad, B] bf16 write + read
-        + 2 * n_pad * N_FEATURES * NUM_BINS * 4)  # hist out
-    hbm_est_s = hbm_bytes / 819e9
+    # Roofline is a v5e-1 TPU model; off-chip it is meaningless (r4 VERDICT
+    # weak #2: a CPU run carried "VPU utilization" in the official artifact),
+    # so it is only emitted when the measurement actually ran on a TPU.
+    roofline = None
+    if platform == "tpu":
+        levels = accel_rounds * MAX_DEPTH
+        vpu_lane_ops = levels * N_ROWS * N_FEATURES * NUM_BINS * 2  # cmp+add
+        vpu_est_s = vpu_lane_ops / (8 * 128 * 0.94e9)
+        n_pad = 16  # min node padding; W rows per level >= 2*n_pad
+        hbm_bytes = levels * (
+            N_ROWS * N_FEATURES * 4          # bins tile stream (int32)
+            + 2 * n_pad * N_ROWS * 2 * 2     # W [2n_pad, B] bf16 write + read
+            + 2 * n_pad * N_FEATURES * NUM_BINS * 4)  # hist out
+        hbm_est_s = hbm_bytes / 819e9
+        roofline = {
+            "vpu_onehot_est_s": round(vpu_est_s, 4),
+            "hbm_stream_est_s": round(hbm_est_s, 4),
+            "vpu_utilization_vs_measured": round(
+                vpu_est_s / accel_s, 3) if accel_s else None,
+            "model": "levels*B*F*nbins*2 lane-ops / (8x128 lanes "
+                     "@0.94GHz); bytes: bins+W+hist per level @819GB/s "
+                     "(v5e-1)",
+        }
     result = {
         "metric": "gbdt_hist_train_rows_per_sec_per_chip",
         "value": round(accel_rps, 1),
@@ -199,17 +234,10 @@ def run_bench(force_cpu):
             "seconds": round(accel_s, 3),
             "cpu_rows_per_sec": round(cpu_rps, 1),
             "train_acc": round(acc, 4),
-            "roofline": {
-                "vpu_onehot_est_s": round(vpu_est_s, 4),
-                "hbm_stream_est_s": round(hbm_est_s, 4),
-                "vpu_utilization_vs_measured": round(
-                    vpu_est_s / accel_s, 3) if accel_s else None,
-                "model": "levels*B*F*nbins*2 lane-ops / (8x128 lanes "
-                         "@0.94GHz); bytes: bins+W+hist per level @819GB/s "
-                         "(v5e-1)",
-            },
         },
     }
+    if roofline is not None:
+        result["detail"]["roofline"] = roofline
     print(JSON_TAG + json.dumps(result), flush=True)
 
 
@@ -224,17 +252,30 @@ def attempt(mode, timeout_s):
     except subprocess.TimeoutExpired:
         print(f"bench child {mode} timed out after {timeout_s}s",
               file=sys.stderr)
+        persist_stage(_stage_name(mode),
+                      {"error": f"timeout after {timeout_s}s"})
         return None
     for line in proc.stdout.splitlines():
         if line.startswith(JSON_TAG):
             try:
-                return json.loads(line[len(JSON_TAG):])
+                parsed = json.loads(line[len(JSON_TAG):])
+                persist_stage(_stage_name(mode), parsed)
+                return parsed
             except json.JSONDecodeError:
                 pass
     tail = (proc.stderr or "")[-2000:]
     print(f"bench child {mode} failed rc={proc.returncode}:\n{tail}",
           file=sys.stderr)
+    persist_stage(_stage_name(mode),
+                  {"error": f"rc={proc.returncode}", "stderr_tail": tail})
     return None
+
+
+def _stage_name(mode):
+    """Stage file name keyed by mode AND workload size, so checklist runs
+    at different BENCH_ROWS (200k then 2M) never clobber each other's
+    persisted evidence."""
+    return f"attempt{mode.replace('-', '_')}_rows{N_ROWS}"
 
 
 def main():

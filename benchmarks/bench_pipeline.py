@@ -3,7 +3,7 @@
 split_read_test.cc, libsvm_parser_test.cc — they print MB/sec).
 
     python benchmarks/bench_pipeline.py split  <uri> [part] [nparts] [type]
-    python benchmarks/bench_pipeline.py parser <uri> [format]
+    python benchmarks/bench_pipeline.py parser <uri> [format] [nthread]
     python benchmarks/bench_pipeline.py gen    <path> [rows] [features]
     python benchmarks/bench_pipeline.py genrec <path.rec> [records] [bytes]
     python benchmarks/bench_pipeline.py infeed <path.rec> [record_bytes] [batch]
@@ -32,11 +32,11 @@ def bench_split(uri, part=0, nparts=1, type_="text"):
     print(meter.summary())
 
 
-def bench_parser(uri, fmt="auto"):
+def bench_parser(uri, fmt="auto", nthread=2):
     from dmlc_core_tpu.data.factory import create_parser
     from dmlc_core_tpu.utils.profiler import ThroughputMeter
 
-    parser = create_parser(uri, type=fmt)
+    parser = create_parser(uri, type=fmt, nthread=int(nthread))
     meter = ThroughputMeter("parse")
     rows = 0
     for block in parser:

@@ -1,0 +1,89 @@
+"""Roofline-gap profile (r4 VERDICT item 7).
+
+bench.py's lane-op roofline says a 130 ms fast-mode fit sits at ~27% of the
+v5e-1 VPU bound — so either ~3.7x kernel headroom exists or the model is
+wrong.  This script separates the two by timing the pallas hist kernel IN
+ISOLATION at the exact shapes the bench fit uses per tree level, comparing
+that to (a) the lane-op bound for one level and (b) the measured per-level
+share of the full fit.  Three outcomes:
+
+  * kernel alone ~= lane-op bound, fit slower  -> overhead between levels
+    (partition/apply/host sync), not kernel headroom;
+  * kernel alone ~= fit per-level share >> bound -> real kernel headroom;
+  * kernel alone << bound                        -> the roofline model
+    overestimates the work (e.g. compares don't cost a full lane-op each).
+
+Writes its findings as text; the checklist captures it in
+benchmarks/results/09_roofline.log.  Runs on whatever backend jax gives
+us but labels non-TPU runs as counterfactual.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu.ops.hist_pallas import (
+    grad_hist_pallas, grad_hist_pallas_fused, pallas_supported,
+    pallas_fused_supported, hist_node_block)
+
+ROWS, F, NBINS = 200_000, 28, 256
+ROUNDS, DEPTH = 10, 6
+
+
+def bench_fn(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    platform = jax.devices()[0].platform
+    print(f"platform={platform}"
+          + ("" if platform == "tpu" else "  (NOT TPU - counterfactual)"))
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, NBINS, (ROWS, F)), jnp.int32)
+    grad = jnp.asarray(rng.randn(ROWS), jnp.float32)
+    hess = jnp.ones((ROWS,), jnp.float32)
+
+    total_kernel_s = 0.0
+    for depth in range(DEPTH):
+        num_nodes = 2 ** depth
+        node_ids = jnp.asarray(
+            rng.randint(0, num_nodes, (ROWS,)), jnp.int32)
+        use_fused = pallas_fused_supported() and platform == "tpu"
+        fn = grad_hist_pallas_fused if use_fused else grad_hist_pallas
+        if not (pallas_supported() or platform != "tpu"):
+            print("pallas unsupported on this backend"); return
+        jfn = jax.jit(lambda b, n, g, h, nn=num_nodes, f=fn:
+                      f(b, n, g, h, nn, NBINS))
+        t = bench_fn(jfn, bins, node_ids, grad, hess)
+        # one level of the roofline model: B*F*nbins*2 lane-ops
+        lane_ops = ROWS * F * NBINS * 2
+        bound_s = lane_ops / (8 * 128 * 0.94e9)
+        nb = hist_node_block(num_nodes, F, NBINS)
+        print(f"depth={depth} nodes={num_nodes:2d} kernel={'fused' if use_fused else 'matmul'} "
+              f"node_block={nb} t={t*1e3:7.2f} ms  lane-bound={bound_s*1e3:6.2f} ms  "
+              f"util={bound_s/t:5.1%}")
+        total_kernel_s += t
+
+    fit_levels = ROUNDS * DEPTH
+    per_tree_kernel_s = total_kernel_s  # one tree = depths 0..DEPTH-1
+    print(f"\nkernel-only, one tree (6 levels): {per_tree_kernel_s*1e3:.1f} ms"
+          f"  -> x{ROUNDS} trees = {per_tree_kernel_s*ROUNDS*1e3:.1f} ms")
+    print(f"fit lane-op bound ({fit_levels} levels): "
+          f"{fit_levels*ROWS*F*NBINS*2/(8*128*0.94e9)*1e3:.1f} ms")
+    print("compare against the measured full-fit time from bench.py: the\n"
+          "difference between (kernel-only x trees) and the full fit is\n"
+          "inter-level overhead; the difference between kernel-only and the\n"
+          "lane bound is true kernel headroom (or model error).")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,80 +1,67 @@
 #!/bin/bash
 # On-chip validation checklist — run this first whenever a TPU is reachable
-# (the axon tunnel was wedged for most of round 3; these are the measurements
-# queued behind it). Each step is independent; comment out what you don't
-# need. Expected wall time ~15 min, dominated by first-compiles.
-set -x
+# (the axon tunnel was wedged for most of rounds 3 AND 4; these are the
+# measurements queued behind it).
+#
+# Wedge-resilience (r4 VERDICT item 1): every step runs under its own
+# `timeout`, tees stdout+stderr into benchmarks/results/NN_<name>.log the
+# moment it finishes, and a failure/hang in one step does NOT abort the
+# rest — partial evidence survives a mid-run tunnel wedge.  bench.py
+# additionally persists per-attempt JSON via BENCH_STAGE_DIR.
+set -u
 cd "$(dirname "$0")/.."
+RESULTS=benchmarks/results
+mkdir -p "$RESULTS"
+export BENCH_STAGE_DIR="$RESULTS"
+
+run_step() {  # run_step <name> <timeout_s> <cmd...>
+    local name=$1 tmo=$2; shift 2
+    echo "=== [$name] $* (timeout ${tmo}s)"
+    timeout "$tmo" "$@" > "$RESULTS/$name.log" 2>&1
+    local rc=$?
+    echo "rc=$rc" >> "$RESULTS/$name.log"
+    echo "=== [$name] rc=$rc ($( [ $rc -eq 124 ] && echo TIMED-OUT || echo done ))"
+    tail -4 "$RESULTS/$name.log"
+    return $rc
+}
 
 # 0. is the chip actually reachable? (a wedged tunnel hangs jax.devices())
-timeout 120 python -c "import jax; print(jax.devices())" || {
+run_step 00_probe 120 python -c "import jax; print(jax.devices())" || {
     echo "TUNNEL WEDGED/ABSENT - stop here"; exit 1; }
 
 # 1. real-Mosaic kernel lane: lowering + numerics of plain/fused/blocked
 #    kernels, the int8 probe, and a tiny end-to-end fit
-DMLC_TPU_LIVE=1 python -m pytest livetests/ -q -rs
+DMLC_TPU_LIVE=1 run_step 01_livetests 1200 python -m pytest livetests/ -q -rs
 
 # 2. the flagship bench (driver metric): expect ~130-170 ms full fit
 #    (bimodal tunnel noise, see BASELINE.md), i.e. 12-15.4M rows/s
-python bench.py
+run_step 02_bench_200k 1200 python bench.py
 
 # 3. hist-method A/B (pallas vs fused vs onehot full fits)
-python benchmarks/bench_hist_variants.py
+run_step 03_hist_variants 900 python benchmarks/bench_hist_variants.py
 
-# 4. sparsity-aware fit on chip (new in late r3; never chip-measured):
-#    full fit with 20% NaN + learned default directions
-python - <<'EOF'
-import time, numpy as np, jax
-from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
-rows, F = 200_000, 28
-rng = np.random.RandomState(0)
-x = rng.randn(rows, F).astype(np.float32)
-y = (x @ rng.randn(F) > 0).astype(np.float32)
-x[rng.rand(rows, F) < 0.2] = np.nan
-m = GBDT(GBDTParam(num_boost_round=10, max_depth=6, num_bins=256,
-                   handle_missing=True), num_feature=F)
-m.make_bins(x[:50_000])
-bins = np.asarray(m.bin_features(x), np.int32)
-ens, margin = m.fit_binned(bins, y)          # warm compile
-jax.block_until_ready(margin)
-best = 1e9
-for _ in range(3):
-    t0 = time.perf_counter()
-    ens, margin = m.fit_binned(bins, y)
-    jax.block_until_ready(margin)
-    best = min(best, time.perf_counter() - t0)
-print(f"sparsity-aware fit: {best*1e3:.1f} ms  "
-      f"{rows*10/best/1e6:.2f}M rows/s (vs ~130-170 ms dense)")
-EOF
+# 4. sparsity-aware fit on chip (never chip-measured): full fit with 20%
+#    NaN + learned default directions
+run_step 04_sparse_fit 900 python benchmarks/snippets/sparse_fit.py
 
 # 5. compiled eval fit on chip (one jit vs per-round host syncs through
 #    the tunnel — the case the compiled path exists for)
-python - <<'EOF'
-import time, numpy as np, jax
-from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
-rng = np.random.RandomState(0)
-x = rng.randn(200_000, 28).astype(np.float32)
-y = (x @ rng.randn(28) > 0).astype(np.float32)
-m = GBDT(GBDTParam(num_boost_round=10, max_depth=6, num_bins=256),
-         num_feature=28)
-m.make_bins(x[:50_000])
-bins = np.asarray(m.bin_features(x), np.int32)
-tr, ev, ytr, yev = bins[:160_000], bins[160_000:], y[:160_000], y[160_000:]
-for mode in (True, False):
-    m.fit_with_eval(tr, ytr, ev, yev, compiled=mode)
-    t0 = time.perf_counter()
-    m.fit_with_eval(tr, ytr, ev, yev, compiled=mode)
-    print(f"eval fit compiled={mode}: {time.perf_counter()-t0:.3f}s")
-EOF
+run_step 05_eval_fit 900 python benchmarks/snippets/eval_fit.py
 
-# ---- round 4 additions -----------------------------------------------------
-# 6. lever sweep: block_rows A/B, i8 probe, dead-row diagnostic, 2M-row scale
-#    (VERDICT r3 items 2 + 6)
-python benchmarks/bench_levers.py 2000000
+# 6. lever sweep: block_rows A/B, i8 probe, dead-row diagnostic, 2M-row
+#    scale
+run_step 06_levers 1800 python benchmarks/bench_levers.py 2000000
 
-# 7. scaled driver-metric capture: rows/sec at 2M rows must land within ~20%
-#    of the 200k figure (headline not a small-working-set artifact)
-BENCH_ROWS=2000000 python bench.py
+# 7. scaled driver-metric capture: rows/sec at 2M rows must land within
+#    ~20% of the 200k figure (headline not a small-working-set artifact)
+BENCH_ROWS=2000000 run_step 07_bench_2m 1800 python bench.py
 
-# 8. cached + remote fast-path numbers on this host (VERDICT r3 item 3)
-python benchmarks/bench_cached.py 256 --remote
+# 8. cached + remote fast-path numbers on this host
+run_step 08_cached 900 python benchmarks/bench_cached.py 256 --remote
+
+# 9. roofline-gap profile (r4 VERDICT item 7): per-kernel timing of the
+#    pallas hist at bench shapes vs the lane-op bound
+run_step 09_roofline 900 python benchmarks/bench_roofline_gap.py
+
+echo "=== checklist complete; results in $RESULTS/"
+ls -la "$RESULTS"

@@ -1,0 +1,21 @@
+"""Compiled eval fit on chip: one jit vs per-round host syncs through the
+tunnel (checklist step 5; extracted from the former heredoc)."""
+import time
+
+import numpy as np
+
+from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+rng = np.random.RandomState(0)
+x = rng.randn(200_000, 28).astype(np.float32)
+y = (x @ rng.randn(28) > 0).astype(np.float32)
+m = GBDT(GBDTParam(num_boost_round=10, max_depth=6, num_bins=256),
+         num_feature=28)
+m.make_bins(x[:50_000])
+bins = np.asarray(m.bin_features(x), np.int32)
+tr, ev, ytr, yev = bins[:160_000], bins[160_000:], y[:160_000], y[160_000:]
+for mode in (True, False):
+    m.fit_with_eval(tr, ytr, ev, yev, compiled=mode)
+    t0 = time.perf_counter()
+    m.fit_with_eval(tr, ytr, ev, yev, compiled=mode)
+    print(f"eval fit compiled={mode}: {time.perf_counter()-t0:.3f}s")

@@ -1,0 +1,29 @@
+"""Sparsity-aware fit on chip: full fit with 20% NaN + learned default
+directions (checklist step 4; extracted from the former heredoc so the
+checklist can run it under its own timeout/log)."""
+import time
+
+import numpy as np
+import jax
+
+from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+rows, F = 200_000, 28
+rng = np.random.RandomState(0)
+x = rng.randn(rows, F).astype(np.float32)
+y = (x @ rng.randn(F) > 0).astype(np.float32)
+x[rng.rand(rows, F) < 0.2] = np.nan
+m = GBDT(GBDTParam(num_boost_round=10, max_depth=6, num_bins=256,
+                   handle_missing=True), num_feature=F)
+m.make_bins(x[:50_000])
+bins = np.asarray(m.bin_features(x), np.int32)
+ens, margin = m.fit_binned(bins, y)          # warm compile
+jax.block_until_ready(margin)
+best = 1e9
+for _ in range(3):
+    t0 = time.perf_counter()
+    ens, margin = m.fit_binned(bins, y)
+    jax.block_until_ready(margin)
+    best = min(best, time.perf_counter() - t0)
+print(f"sparsity-aware fit: {best*1e3:.1f} ms  "
+      f"{rows*10/best/1e6:.2f}M rows/s (vs ~130-170 ms dense)")

@@ -590,10 +590,8 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             InputSplitBase.before_first(self)
         reader = self._native_reader()
         if reader is not None:
-            if self._span_adapter is not None:
-                # new epoch: drop cached remote streams (producer-side, on
-                # its next read) and forget any stale parked error
-                self._span_adapter.request_reopen()
+            # set_plan's native Invalidate() sentinel drops cached remote
+            # streams + stale errors with the producer joined (race-free)
             offs, szs, counts = self._epoch_plan()
             reader.set_plan(offs, szs, counts)
             self._plan_batch = self._batch_size
@@ -1065,25 +1063,30 @@ class _ReadAtAdapter:
     callable serving (file_idx, offset, size) reads from any FileSystem's
     SeekStreams.  Runs on the native prefetch thread (ctypes takes the GIL
     per call); the first exception is parked on ``.error`` and surfaces as
-    the stream error when the consumer next pops a chunk."""
+    the stream error when the consumer next pops a chunk.
+
+    Epoch boundaries arrive as an ``idx < 0`` sentinel call: the native
+    engines issue it from ``Invalidate()`` strictly between joining the
+    old producer and starting the new one, so dropping cached streams and
+    forgetting a stale parked error here can never race an in-flight read
+    (ADVICE r4: the old consumer-side reopen flag could clear ``.error``
+    just before a dying read re-parked its dead-epoch exception)."""
 
     def __init__(self, fs: fsys.FileSystem, files):
         self._fs = fs
         self._files = files
         self._streams: dict = {}
         self._pos: dict = {}
-        self._reopen = False
         self.error: Optional[BaseException] = None
 
     def __call__(self, ctx, idx, offset, buf, size) -> int:
         try:
-            if self._reopen:
-                # stream teardown runs HERE, on the producer thread that
-                # owns the stream dict — request_reopen() from the consumer
-                # thread only flips the flag, so there is no race with an
-                # in-flight read
-                self._reopen = False
+            if idx < 0:
+                # invalidate sentinel (new epoch / replaced files): no
+                # producer is alive, so teardown + error clear are race-free
                 self._close_streams()
+                self.error = None
+                return 0
             stream = self._streams.get(idx)
             if stream is None:
                 stream = self._fs.open_for_read(self._files[idx].path)
@@ -1099,13 +1102,6 @@ class _ReadAtAdapter:
         except BaseException as exc:  # noqa: BLE001 — ferried to the consumer
             self.error = exc
             return -1
-
-    def request_reopen(self) -> None:
-        """Epoch boundary: have the producer thread drop its cached streams
-        before its next read (so a new epoch observes replaced objects);
-        also forgets a previous epoch's parked error."""
-        self.error = None
-        self._reopen = True
 
     def _close_streams(self) -> None:
         for stream in self._streams.values():
@@ -1177,10 +1173,8 @@ class NativeLineSplitter(InputSplit):
         self._cursor = ChunkCursor()
 
     def before_first(self) -> None:
-        if self._adapter is not None:
-            # reopen remote streams on the new epoch (flag only — the
-            # producer thread does the teardown itself, race-free)
-            self._adapter.request_reopen()
+        # reset()'s native Invalidate() sentinel reopens remote streams and
+        # clears stale adapter errors between producer join and restart
         self._native.reset(self._part, self._nparts)
         self._cursor = ChunkCursor()
 

@@ -77,7 +77,12 @@ class GBDTParam(Parameter):
                            help="cap on |leaf weight| before shrinkage "
                                 "(XGBoost's imbalanced-logistic stabiliser; "
                                 "0 disables). Applied to leaf values AND "
-                                "to split gain scoring, matching XGBoost")
+                                "to split gain scoring like XGBoost; with "
+                                "reg_alpha>0 AND a binding cap the gain's "
+                                "alpha term is the self-consistent -2a|w| "
+                                "(XGBoost's CalcGain uses +a|w| there), so "
+                                "split choices can differ from XGBoost in "
+                                "that corner")
     seed = field(int, default=0, help="subsampling PRNG seed")
     monotone_constraints = field(str, default="",
                                  help="per-feature monotone directions, "

@@ -164,10 +164,19 @@ def prepare_shipping(opts, wrap_launcher: bool = False,
     return env, command, files, archives
 
 
+def remote_python() -> str:
+    """Interpreter name to use in remote/container command lines.  Default
+    ``python3``: a bare ``python`` does not exist on python3-only hosts
+    (default Debian/Ubuntu and most cluster images).  Overridable for
+    clusters whose interpreter lives elsewhere."""
+    return os.environ.get("DMLC_REMOTE_PYTHON", "python3")
+
+
 def wrap_launcher_cmd(command: List[str]) -> List[str]:
     """Route a task command through the container-side launcher (which
     materializes DMLC_JOB_FILES / unpacks DMLC_JOB_ARCHIVES)."""
-    return ["python", "-m", "dmlc_core_tpu.tracker.launcher"] + list(command)
+    return [remote_python(), "-m", "dmlc_core_tpu.tracker.launcher"] \
+        + list(command)
 
 
 def prepare_scp_shipping(opts):

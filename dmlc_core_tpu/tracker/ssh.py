@@ -88,13 +88,13 @@ def _unpack_prelude(archives: List[str]) -> str:
     """Remote shell prelude unpacking shipped archives with a stdlib-only
     python one-liner (no framework install needed on the remote side);
     dest naming matches the launcher's src#dest rule."""
-    from dmlc_core_tpu.tracker.filecache import split_spec_item
+    from dmlc_core_tpu.tracker.filecache import remote_python, split_spec_item
 
     steps = []
     for item in archives:
         src, dest = split_spec_item(item, archive=True)
         # the zip was shipped under its basename into the workdir
-        steps.append(f"python -c {_shquote(_REMOTE_UNZIP)} "
+        steps.append(f"{remote_python()} -c {_shquote(_REMOTE_UNZIP)} "
                      f"{_shquote(os.path.basename(src))} {_shquote(dest)}")
     return "; ".join(steps)
 

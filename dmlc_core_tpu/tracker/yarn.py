@@ -79,9 +79,11 @@ def _launch_command(opts, envs: Dict[str, str], task: TaskRecord) -> str:
                                          "DMLC_NUM_ATTEMPT":
                                              str(task.attempts),
                                          "DMLC_JOB_CLUSTER": "yarn"}.items())
+    from dmlc_core_tpu.tracker.filecache import remote_python
+
     cmd = " ".join(opts.command)
-    return (f"{exports} && python -m dmlc_core_tpu.tracker.launcher {cmd} "
-            f"1><LOG_DIR>/stdout 2><LOG_DIR>/stderr")
+    return (f"{exports} && {remote_python()} -m dmlc_core_tpu.tracker.launcher "
+            f"{cmd} 1><LOG_DIR>/stdout 2><LOG_DIR>/stderr")
 
 
 class RestYarnCluster(ClusterBackend):

@@ -166,6 +166,13 @@ class CallbackSource : public ByteSource {
   // is only the native-visible fallback text
   std::string LastError() const override { return "reader callback failed"; }
 
+  // Reopen sentinel: engines only call this between queue_.Stop() (which
+  // joins the producer) and the new epoch's queue_.Start(), so the Python
+  // side can drop cached streams AND forget a parked stale error with no
+  // in-flight read to race against (the pre-r5 consumer-side flag flip
+  // could clear an error an old in-flight read was about to park).
+  void Invalidate() override { fn_(ctx_, -1, 0, nullptr, 0); }
+
  private:
   dmlc_tpu_read_at_fn fn_;
   void *ctx_;
@@ -611,7 +618,11 @@ class CacheReplayEngine {
     unsigned char hdr[8];
     size_t n = std::fread(hdr, 1, 8, fp_);
     if (n < 8) {
+      // n == 0 is clean end-of-cache ONLY if it is a real EOF: an I/O
+      // error landing exactly on a frame boundary must fail loudly, not
+      // silently truncate the epoch (ADVICE r4)
       if (n != 0) Fail("truncated cache frame header");
+      else if (std::ferror(fp_)) Fail("cache read error in " + path_);
       return false;
     }
     remaining_ -= 8;

@@ -4,7 +4,8 @@
 // src/data/strtonum.h); this library is the TPU-native rebuild's equivalent:
 // multi-threaded chunk -> CSR parsing for libsvm/libfm and chunk -> dense for
 // csv, exposed through a plain C ABI consumed via ctypes (no pybind11 in the
-// image). Number parsing uses std::from_chars (C++17), which matches or beats
+// image). Number parsing uses a fast-path u64-mantissa decimal scan with a
+// std::from_chars (C++17) fallback for exotic tokens; the combination beats
 // the reference's hand-rolled strtof (src/data/strtonum.h:37-101).
 //
 // Threading model mirrors the reference's OpenMP chunk split
@@ -59,17 +60,168 @@ inline const char* skip_ws(const char* p, const char* end) {
   return p;
 }
 
-inline bool parse_float(const char*& p, const char* end, float* out) {
+inline bool parse_float_slow(const char*& p, const char* end, float* out) {
   auto res = std::from_chars(p, end, *out);
   if (res.ec != std::errc()) return false;
   p = res.ptr;
   return true;
 }
 
+// Powers of ten as one branchless table indexed by e10 + 22.  Positive
+// powers up to 1e22 are exactly representable, so (double)mant * 10^e is a
+// single correctly-rounded op there; negative powers as multiplies are ~1
+// double ulp off the exact division but ~15 cycles faster, and the final
+// double->float truncation swallows the difference (worst case stays 1
+// float ulp vs from_chars).
+constexpr double kPow10Signed[] = {
+    1e-22, 1e-21, 1e-20, 1e-19, 1e-18, 1e-17, 1e-16, 1e-15, 1e-14,
+    1e-13, 1e-12, 1e-11, 1e-10, 1e-9,  1e-8,  1e-7,  1e-6,  1e-5,
+    1e-4,  1e-3,  1e-2,  1e-1,  1e0,   1e1,   1e2,   1e3,   1e4,
+    1e5,   1e6,   1e7,   1e8,   1e9,   1e10,  1e11,  1e12,  1e13,
+    1e14,  1e15,  1e16,  1e17,  1e18,  1e19,  1e20,  1e21,  1e22};
+
+// SWAR digit-run helpers (the reference compiles -msse2 and hand-rolls its
+// strtof; this is the same idea one word at a time): 8 (or 4) ASCII digits
+// are validated and converted with three multiply-mask steps instead of a
+// per-byte loop.
+inline bool all8_digits(uint64_t x) {
+  return ((x & 0xF0F0F0F0F0F0F0F0ull) |
+          (((x + 0x0606060606060606ull) & 0xF0F0F0F0F0F0F0F0ull) >> 4)) ==
+         0x3333333333333333ull;
+}
+
+inline uint32_t swar8_to_u32(uint64_t x) {
+  x = (x & 0x0F0F0F0F0F0F0F0Full) * 2561 >> 8;
+  x = (x & 0x00FF00FF00FF00FFull) * 6553601 >> 16;
+  return static_cast<uint32_t>(
+      (x & 0x0000FFFF0000FFFFull) * 42949672960001ull >> 32);
+}
+
+inline bool all4_digits(uint32_t x) {
+  return ((x & 0xF0F0F0F0u) |
+          (((x + 0x06060606u) & 0xF0F0F0F0u) >> 4)) == 0x33333333u;
+}
+
+inline uint32_t swar4_to_u32(uint32_t x) {
+  x = (x & 0x0F0F0F0Fu) * 2561 >> 8;
+  return (x & 0x00FF00FFu) * 6553601 >> 16;
+}
+
+// Append a digit run to *mant; returns one past the last digit consumed.
+// Tuned for fraction runs, which are typically >= 4 digits ("%.4f"-ish
+// writers): one 4-gulp attempt first (cheapest win), 8-gulps only while
+// the run keeps going, single bytes for the tail.
+inline const char* scan_digits(const char* q, const char* end,
+                               uint64_t* mant) {
+  if (end - q >= 4) {
+    uint32_t x;
+    std::memcpy(&x, q, 4);
+    if (all4_digits(x)) {
+      *mant = *mant * 10000u + swar4_to_u32(x);
+      q += 4;
+      // runs longer than 4 are rare; one cheap byte test gates the wide
+      // gulps so the common "%.4f" case pays nothing extra
+      if (q != end && static_cast<unsigned char>(*q - '0') < 10u) {
+        while (end - q >= 8) {
+          uint64_t y;
+          std::memcpy(&y, q, 8);
+          if (!all8_digits(y)) break;
+          *mant = *mant * 100000000ull + swar8_to_u32(y);
+          q += 8;
+        }
+      }
+    }
+  }
+  while (q != end && static_cast<unsigned char>(*q - '0') < 10u)
+    *mant = *mant * 10u + static_cast<unsigned>(*q++ - '0');
+  return q;
+}
+
+// Plain per-byte run for positions where short runs dominate (integer
+// parts and labels are usually 1-2 digits; a SWAR attempt there is pure
+// overhead).
+inline const char* scan_digits_short(const char* q, const char* end,
+                                     uint64_t* mant) {
+  while (q != end && static_cast<unsigned char>(*q - '0') < 10u)
+    *mant = *mant * 10u + static_cast<unsigned>(*q++ - '0');
+  return q;
+}
+
+// Fast decimal float: the overwhelmingly common token shape in ML text
+// formats is a short fixed-point decimal ("%.4f"-ish), for which the
+// general-purpose std::from_chars pays for machinery it never uses.  This
+// accumulates the digits into a u64 mantissa (SWAR, 8 at a time) and
+// applies one power-of-ten double multiply — within ~1 double ulp of the
+// exactly-rounded value for <= 15 digits and |e10| <= 22, then one
+// double->float conversion (worst case 1 float ulp from from_chars; the
+// reference's own strtof, src/data/strtonum.h:37-101, carries a larger
+// error of the same class).  Anything
+// else (inf/nan, long mantissas, big exponents) falls back to from_chars,
+// preserving its accept/reject semantics exactly.
+inline bool parse_float(const char*& p, const char* end, float* out) {
+  const char* q = p;
+  // ~half the values in real ML data are negative, so a sign *branch* is a
+  // guaranteed-mispredict tax; do it with arithmetic only
+  const bool neg = (q != end && *q == '-');
+  q += neg;
+  uint64_t mant = 0;
+  const char* d0 = q;
+  q = scan_digits_short(q, end, &mant);
+  int ndig = static_cast<int>(q - d0);
+  int e10 = 0;
+  if (q != end && *q == '.') {
+    const char* f0 = ++q;
+    q = scan_digits(q, end, &mant);
+    e10 = -static_cast<int>(q - f0);
+    ndig += static_cast<int>(q - f0);
+  }
+  if (ndig == 0 || ndig > 18) return parse_float_slow(p, end, out);
+  if (q != end && (*q == 'e' || *q == 'E')) {
+    const char* esave = q++;
+    bool eneg = false;
+    if (q != end && (*q == '+' || *q == '-')) eneg = *q++ == '-';
+    const char* e0 = q;
+    int ev = 0;
+    while (q != end && static_cast<unsigned char>(*q - '0') < 10u && ev < 10000)
+      ev = ev * 10 + (*q++ - '0');
+    if (q == e0) {
+      q = esave;  // "1e"/"1e+": from_chars ends the token before the 'e'
+    } else {
+      if (q != end && static_cast<unsigned char>(*q - '0') < 10u)
+        return parse_float_slow(p, end, out);  // absurd exponent length
+      e10 += eneg ? -ev : ev;
+    }
+  }
+  if (static_cast<unsigned>(e10 + 22) > 44u)
+    return parse_float_slow(p, end, out);
+  double d = static_cast<double>(mant) * kPow10Signed[e10 + 22];
+  if (d > 3.402823466e+38) return parse_float_slow(p, end, out);
+  // (overflow beyond FLT_MAX defers to from_chars, which rejects it as
+  // out_of_range exactly like the pre-rewrite parser; also avoids the UB
+  // of an out-of-range double->float conversion)
+  float fv = static_cast<float>(d);
+  uint32_t fb;
+  std::memcpy(&fb, &fv, 4);
+  fb |= static_cast<uint32_t>(neg) << 31;  // branchless negate (fv >= 0)
+  std::memcpy(&fv, &fb, 4);
+  *out = fv;
+  p = q;
+  return true;
+}
+
 inline bool parse_u32(const char*& p, const char* end, uint32_t* out) {
-  auto res = std::from_chars(p, end, *out);
-  if (res.ec != std::errc()) return false;
-  p = res.ptr;
+  const char* q = p;
+  uint64_t v = 0;
+  while (q != end && static_cast<unsigned char>(*q - '0') < 10u) {
+    v = v * 10u + static_cast<unsigned>(*q++ - '0');
+    // value check, not digit count: zero-padded in-range indices must
+    // still parse (from_chars semantics); v < 2^32 entering the step
+    // keeps the u64 accumulator overflow-free
+    if (v > 0xffffffffull) return false;  // like from_chars out_of_range
+  }
+  if (q == p) return false;
+  *out = static_cast<uint32_t>(v);
+  p = q;
   return true;
 }
 
@@ -100,59 +252,67 @@ std::vector<std::pair<const char*, const char*>> split_ranges(
 // src/data/libsvm_parser.h:35-90). Empty lines skipped.
 void parse_libsvm_range(const char* begin, const char* end, Shard* s) {
   const char* p = begin;
+  const size_t len = static_cast<size_t>(end - begin);
+  // capacity up front so the hot loop's push_backs never reallocate: the
+  // densest legal token is ~4 bytes ("1:2 "), typical is ~10
+  s->index.reserve(len / 6);
+  s->value.reserve(len / 6);
+  s->label.reserve(len / 64);
+  s->weight.reserve(len / 64);
+  s->row_nnz.reserve(len / 64);
+  bool any_value = false, any_weight = false;
+  // single pass, no per-line memchr: '\n' is just another terminator the
+  // number scanners already stop at, so every byte is touched once
   while (p < end) {
-    const char* lend = static_cast<const char*>(memchr(p, '\n', end - p));
-    if (!lend) lend = end;
-    p = skip_ws(p, lend);
-    if (p < lend) {
-      float label;
-      if (!parse_float(p, lend, &label)) {
+    while (p < end && (is_ws(*p) || *p == '\n')) ++p;  // blank lines too
+    if (p >= end) break;
+    float label;
+    if (!parse_float(p, end, &label)) {
+      s->error = true;
+      s->error_msg = "invalid label in libsvm input";
+      return;
+    }
+    float w = 1.0f;
+    if (p < end && *p == ':') {
+      ++p;
+      if (!parse_float(p, end, &w)) {
         s->error = true;
-        s->error_msg = "invalid label in libsvm input";
+        s->error_msg = "invalid weight in libsvm input";
         return;
       }
-      float w = 1.0f;
-      bool has_w = false;
-      if (p < lend && *p == ':') {
-        ++p;
-        if (!parse_float(p, lend, &w)) {
-          s->error = true;
-          s->error_msg = "invalid weight in libsvm input";
-          return;
-        }
-        has_w = true;
-      }
-      int64_t nnz = 0;
-      while (true) {
-        p = skip_ws(p, lend);
-        if (p >= lend) break;
-        uint32_t idx;
-        if (!parse_u32(p, lend, &idx)) {
-          s->error = true;
-          s->error_msg = "invalid feature index in libsvm input";
-          return;
-        }
-        float v = 1.0f;
-        if (p < lend && *p == ':') {
-          ++p;
-          if (!parse_float(p, lend, &v)) {
-            s->error = true;
-            s->error_msg = "invalid feature value in libsvm input";
-            return;
-          }
-          s->any_value = true;
-        }
-        s->index.push_back(idx);
-        s->value.push_back(v);
-        ++nnz;
-      }
-      s->label.push_back(label);
-      s->weight.push_back(w);
-      if (has_w) s->any_weight = true;
-      s->row_nnz.push_back(nnz);
+      any_weight = true;
     }
-    p = lend < end ? lend + 1 : end;
+    int64_t nnz = 0;
+    while (true) {
+      if (p < end && *p == ' ') ++p;      // the common single separator
+      while (p < end && is_ws(*p)) ++p;
+      if (p >= end || *p == '\n') break;
+      uint32_t idx;
+      if (!parse_u32(p, end, &idx)) {
+        s->error = true;
+        s->error_msg = "invalid feature index in libsvm input";
+        return;
+      }
+      float v = 1.0f;
+      if (p < end && *p == ':') {
+        ++p;
+        if (!parse_float(p, end, &v)) {
+          s->error = true;
+          s->error_msg = "invalid feature value in libsvm input";
+          return;
+        }
+        any_value = true;
+      }
+      s->index.push_back(idx);
+      s->value.push_back(v);
+      ++nnz;
+    }
+    s->label.push_back(label);
+    s->weight.push_back(w);
+    s->row_nnz.push_back(nnz);
   }
+  s->any_value |= any_value;
+  s->any_weight |= any_weight;
 }
 
 // ---------------------------------------------------------------- libfm -----

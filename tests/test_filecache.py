@@ -113,7 +113,9 @@ def test_prepare_shipping_gates(tmp_path):
     env, cmd, files, ar = prepare_shipping(bare, always=True,
                                            wrap_launcher=True)
     assert files == [f"{script}#job.py"]
-    assert cmd[:3] == ["python", "-m", "dmlc_core_tpu.tracker.launcher"]
+    # remote command lines must name python3 — bare `python` is absent on
+    # python3-only hosts (ADVICE r4)
+    assert cmd[:3] == ["python3", "-m", "dmlc_core_tpu.tracker.launcher"]
     assert cmd[3:] == ["python", "./job.py"]
     assert env["DMLC_JOB_FILES"] == f"{script}#job.py"
     # ...but respects --no-auto-file-cache
