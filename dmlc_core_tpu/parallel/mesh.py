@@ -69,8 +69,25 @@ def make_hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int]):
     names = tuple(dcn_axes.keys()) + tuple(ici_axes.keys())
     dcn_shape = tuple(dcn_axes.values()) + tuple(1 for _ in ici_axes)
     ici_shape = tuple(1 for _ in dcn_axes) + tuple(ici_axes.values())
-    dev_array = mesh_utils.create_hybrid_device_mesh(
-        ici_shape, dcn_shape, allow_split_physical_axes=True)
+    import jax
+
+    # virtual/CPU devices carry no usable slice_index, so the topology-aware
+    # builder cannot run there; a plain reshape (dcn axes outermost) keeps
+    # the axis semantics so the hybrid layout stays testable off-hardware.
+    # On real sliced hardware a builder failure is a REAL error (a silent
+    # reshape would put ICI-named axes across DCN links) and propagates.
+    sliced_hw = any(getattr(d, "slice_index", None) is not None
+                    for d in jax.devices())
+    if sliced_hw:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, allow_split_physical_axes=True)
+    else:
+        shape = tuple(d * i for d, i in zip(dcn_shape, ici_shape))
+        ndev = int(np.prod(shape))
+        devices = np.asarray(jax.devices()[:ndev])
+        CHECK(len(devices) == ndev,
+              f"hybrid mesh {dict(zip(names, shape))} needs {ndev} devices")
+        dev_array = devices.reshape(shape)
     return Mesh(dev_array, names)
 
 

@@ -112,13 +112,17 @@ class WorkerEntry:
         link set (tree + real ring hops) this worker must establish.
         """
         self.rank = rank
+        links = set(tree_links)
         self.sock.sendint(rank)
         self.sock.sendint(parent)
         self.sock.sendint(world)
-        self.sock.sendint(len(tree_links))
-        for peer in tree_links:
+        self.sock.sendint(len(links))
+        # iterate the SET, not the list: the neighbor block is a set on the
+        # wire, and the reference tracker emits it in set-iteration order —
+        # doing the same keeps conversations byte-identical to it
+        # (tests/test_tracker_conformance.py)
+        for peer in links:
             self.sock.sendint(peer)
-        links = set(tree_links)
         for hop in (ring_prev, ring_next):
             if hop in (-1, rank):
                 self.sock.sendint(-1)

@@ -77,7 +77,7 @@ collective.finalize()
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("nworkers", [2, 4])
+@pytest.mark.parametrize("nworkers", [2, 4, 8])
 def test_distributed_gbdt_fit_agrees_across_ranks(tmp_path, nworkers):
     proc = run_tracker_workers(tmp_path, DP_WORKER, nworkers,
                                env_extra={"EXPECT_WORLD": str(nworkers)})

