@@ -49,19 +49,47 @@ def cmd_cat(uri: str) -> int:
 
 def cmd_cp(src_uri: str, dst_uri: str) -> int:
     src = create_stream_for_read(src_uri)
-    dst = create_stream(dst_uri, "w")
-    total = 0
     try:
-        while True:
-            data = src.read(CHUNK)
-            if not data:
-                break
-            dst.write(data)
-            total += len(data)
-    finally:
+        dst = create_stream(dst_uri, "w")
+        total = 0
+        try:
+            while True:
+                data = src.read(CHUNK)
+                if not data:
+                    break
+                dst.write(data)
+                total += len(data)
+        except BaseException:
+            # do NOT commit a truncated destination: closing a half-written
+            # remote stream would finalize the upload and leave an object
+            # that looks complete.  Best effort: remove a local partial;
+            # for remote targets say so explicitly.
+            _discard_partial_dest(dst, dst_uri)
+            raise
         dst.close()
+    finally:
+        src.close()
     print(f"copied {total} bytes {src_uri} -> {dst_uri}", file=sys.stderr)
     return 0
+
+
+def _discard_partial_dest(dst, dst_uri: str) -> None:
+    import os
+
+    if "://" not in dst_uri or dst_uri.startswith("file://"):
+        path = dst_uri[len("file://"):] if dst_uri.startswith("file://") \
+            else dst_uri
+        try:
+            dst.close()
+        except Exception:
+            pass
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    else:
+        print(f"warning: copy failed mid-stream; a partial object may "
+              f"remain at {dst_uri}", file=sys.stderr)
 
 
 def main(argv) -> int:

@@ -17,6 +17,61 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _fit_resumable(model, param, bins, y, args):
+    """Round-by-round fit with CheckpointManager: rerunning with the same
+    --checkpoint-dir resumes at the latest step (docs/guide.md recipe)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+    from dmlc_core_tpu.models.gbdt import TreeEnsemble
+
+    mgr = CheckpointManager(args.checkpoint_dir, keep=3)
+    latest = mgr.latest_step()
+    B = len(y)
+    mshape = (B, param.num_class) if param.objective == "softmax" else (B,)
+    if latest is None:
+        start, trees = 0, []
+        margin = np.full(mshape, param.base_score, np.float32)
+    else:
+        state = {k[2:-2]: v for k, v in mgr.restore(latest).items()}
+        start = int(state["round"])
+        margin = np.asarray(state["margin"], np.float32)
+        trees = []
+        for i in range(start):
+            arity = len([k for k in state if k.startswith(f"t{i}_")])
+            trees.append(tuple(np.asarray(state[f"t{i}_{j}"])
+                               for j in range(arity)))
+        print(f"resuming from checkpoint step {latest} "
+              f"({start}/{args.rounds} rounds done)")
+
+    gmargin = jnp.asarray(margin)
+    weight = jnp.ones((B,), jnp.float32)
+    label = jnp.asarray(y)
+    t0 = time.perf_counter()
+    for r in range(start, args.rounds):
+        gmargin, tree = model.boost_round(gmargin, bins, label, weight,
+                                          round_index=r)
+        trees.append(tuple(np.asarray(a) for a in tree))
+        if (r + 1) % args.checkpoint_every == 0 and (r + 1) < args.rounds:
+            payload = {"round": np.int64(r + 1),
+                       "margin": np.asarray(gmargin)}
+            for i, t in enumerate(trees):
+                for j, arr in enumerate(t):
+                    payload[f"t{i}_{j}"] = arr
+            mgr.save(r + 1, payload)
+    jax.block_until_ready(gmargin)
+    mgr.wait_until_finished()
+    secs = time.perf_counter() - t0
+    ensemble = TreeEnsemble(*[np.stack([t[i] for t in trees])
+                              for i in range(6)])
+    # report only the rounds THIS run trained: secs covers those alone, so
+    # a resumed run must not claim the skipped rounds' throughput
+    return ensemble, np.asarray(gmargin), secs, args.rounds - start
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--data", required=True)
@@ -59,6 +114,12 @@ def main():
                          "(needs --eval-data); ensemble truncates to the "
                          "best round")
     ap.add_argument("--checkpoint", default="")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="resumable training: step-numbered checkpoints "
+                         "land here every --checkpoint-every rounds; "
+                         "rerunning with the same dir resumes from the "
+                         "latest one (docs/guide.md 'Crash recovery')")
+    ap.add_argument("--checkpoint-every", type=int, default=10)
     args = ap.parse_args()
 
     import jax
@@ -125,7 +186,14 @@ def main():
     bins = np.asarray(model.bin_features(x)).astype(np.int32)
 
     rounds_run = args.rounds
-    if args.eval_data:
+    if args.checkpoint_dir:
+        if args.eval_data or args.early_stopping_rounds:
+            ap.error("--checkpoint-dir cannot be combined with --eval-data/"
+                     "--early-stopping-rounds (the resumable loop does not "
+                     "track eval curves yet)")
+        ensemble, margin, secs, rounds_run = _fit_resumable(
+            model, param, bins, y, args)
+    elif args.eval_data:
         ex, ev_y = load_dense(create_parser(args.eval_data, 0, 1,
                                             type="auto"))
         ev_bins = np.asarray(model.bin_features(ex)).astype(np.int32)

@@ -320,6 +320,10 @@ void parse_libsvm_range(const char* begin, const char* end, Shard* s) {
 // src/data/libfm_parser.h).
 void parse_libfm_range(const char* begin, const char* end, Shard* s) {
   const char* p = begin;
+  const size_t len = static_cast<size_t>(end - begin);
+  s->field.reserve(len / 8);
+  s->index.reserve(len / 8);
+  s->value.reserve(len / 8);
   while (p < end) {
     const char* lend = static_cast<const char*>(memchr(p, '\n', end - p));
     if (!lend) lend = end;
@@ -393,6 +397,7 @@ struct CsvShard {
 void parse_csv_range(const char* begin, const char* end, CsvShard* s,
                      float missing) {
   const char* p = begin;
+  s->dense.reserve(static_cast<size_t>(end - begin) / 6);
   while (p < end) {
     const char* lend = static_cast<const char*>(memchr(p, '\n', end - p));
     if (!lend) lend = end;

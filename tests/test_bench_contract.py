@@ -1,0 +1,75 @@
+"""Contract tests for bench.py — the driver-facing benchmark artifact.
+
+r4 VERDICT weak #1/#2: the capture path must be wedge-resilient (per-stage
+result files written the moment each stage completes, so a mid-run tunnel
+wedge can't zero the evidence) and the roofline block — a TPU hardware
+model — must never appear on a CPU-fallback run.  These tests run the real
+bench.py in a subprocess on a tiny workload and assert both properties,
+plus the one-JSON-line stdout contract the driver parses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench_run(tmp_path_factory):
+    stage_dir = tmp_path_factory.mktemp("stages")
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_ROWS": "2000",
+        "BENCH_TPU_ROUNDS": "2",
+        "BENCH_CPU_ROUNDS": "1",
+        # the axon probe would hang on a wedged tunnel; keep it short —
+        # losing the probe must NOT lose the run (that is the point)
+        "BENCH_PROBE_TIMEOUT_S": "3",
+        "BENCH_STAGE_DIR": str(stage_dir),
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=600)
+    return proc, stage_dir
+
+
+def test_emits_exactly_one_json_line(bench_run):
+    proc, _ = bench_run
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    result = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "platform",
+                "tpu_available"):
+        assert key in result, key
+    assert result["metric"] == "gbdt_hist_train_rows_per_sec_per_chip"
+    assert result["value"] > 0
+
+
+def test_stage_files_persist_as_stages_complete(bench_run):
+    proc, stage_dir = bench_run
+    stages = sorted(p.name for p in stage_dir.iterdir())
+    # at minimum the successful child stage must have its own file, keyed
+    # by workload size so a later BENCH_ROWS=2M run can never clobber it
+    assert any("rows2000" in s for s in stages), stages
+    child = [p for p in stage_dir.iterdir() if "child" in p.name]
+    assert child, stages
+    payload = json.loads(child[0].read_text())
+    assert payload["stage"].endswith("rows2000")
+    assert "time" in payload
+
+
+def test_roofline_absent_off_tpu(bench_run):
+    proc, stage_dir = bench_run
+    result = json.loads([l for l in proc.stdout.splitlines()
+                         if l.strip()][0])
+    assert result["platform"] != "tpu"        # this host fell back
+    assert result["tpu_available"] is False
+    # the roofline is a v5e lane-op model: meaningless (and previously
+    # misleading, BENCH_r04.json) on a CPU run
+    assert "roofline" not in result.get("detail", {})

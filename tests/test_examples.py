@@ -119,3 +119,31 @@ def test_train_gbdt_example_with_eval(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "eval: first" in proc.stdout
     assert "trees kept" in proc.stdout
+
+
+@pytest.mark.slow
+def test_train_gbdt_resumable_checkpoints(tmp_path):
+    """--checkpoint-dir: a fresh run writes step checkpoints; a rerun with
+    more rounds resumes from the latest instead of starting over."""
+    rng = np.random.RandomState(3)
+    lines = []
+    for i in range(600):
+        x = rng.randn(6)
+        y = int(x[0] - x[2] > 0)
+        feats = " ".join(f"{j}:{x[j]:.4f}" for j in range(6))
+        lines.append(f"{y} {feats}")
+    data = tmp_path / "train.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+    ckpt = tmp_path / "ckpts"
+    script = os.path.join(REPO, "examples", "train_gbdt.py")
+    base_args = ["--data", str(data), "--num-feature", "6",
+                 "--max-depth", "3", "--hist-method", "scatter",
+                 "--checkpoint-dir", str(ckpt), "--checkpoint-every", "2"]
+    proc = run_example(script, base_args + ["--rounds", "4"])
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert (ckpt / "ckpt-00000002").exists()
+    proc = run_example(script, base_args + ["--rounds", "6"])
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "resuming from checkpoint step 2" in proc.stdout
+    # throughput honesty: the resumed run reports only the rounds IT trained
+    assert "trained 4 rounds" in proc.stdout

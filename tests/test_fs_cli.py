@@ -105,3 +105,10 @@ def test_module_invocation(tmp_path):
         capture_output=True, env=env, cwd=repo, timeout=120)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout == b"module entry"
+
+
+def test_cp_failure_leaves_no_partial_local_dest(tmp_path, capsys):
+    dst = tmp_path / "out.bin"
+    rc = main(["cp", str(tmp_path / "missing.bin"), str(dst)])
+    assert rc == 1
+    assert not dst.exists()
