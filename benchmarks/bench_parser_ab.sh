@@ -1,0 +1,50 @@
+#!/bin/bash
+# Head-to-head libsvm parse benchmark: the reference's own harness
+# (test/libsvm_parser_test.cc, built out-of-tree from /root/reference at
+# -O3 -march=native) vs our pipeline (benchmarks/bench_pipeline.py parser),
+# interleaved to cancel host drift.  This is the protocol behind
+# BASELINE.md "libsvm parse throughput".
+#
+#   benchmarks/bench_parser_ab.sh [rows] [reps]
+set -eu
+cd "$(dirname "$0")/.."
+ROWS=${1:-200000}
+REPS=${2:-3}
+REF=${REFERENCE_DIR:-/root/reference}
+WORK=${WORKDIR:-/tmp/parser_ab}
+mkdir -p "$WORK"
+
+# 1. build the reference harness (once)
+if [ ! -x "$WORK/libsvm_parser_test" ]; then
+    echo "== building reference harness from $REF"
+    cmake -S "$REF" -B "$WORK/refbuild" -DCMAKE_BUILD_TYPE=Release \
+        -G Ninja > "$WORK/cmake.log" 2>&1
+    ninja -C "$WORK/refbuild" dmlc >> "$WORK/cmake.log" 2>&1
+    g++ -O3 -march=native -std=c++17 -I"$REF/include" -I"$REF" \
+        "$REF/test/libsvm_parser_test.cc" "$WORK/refbuild/libdmlc.a" \
+        -o "$WORK/libsvm_parser_test" -lpthread -fopenmp
+fi
+
+# 2. identical input for both; the reference harness only prints every
+#    10 MB read, so refuse sizes it would stay silent on, and generate to
+#    a temp name so an interrupted gen can't leave a truncated cache hit
+if [ "$ROWS" -lt 50000 ]; then
+    echo "rows must be >= 50000 (the reference harness prints nothing below ~14 MB)" >&2
+    exit 2
+fi
+DATA="$WORK/higgs_${ROWS}.libsvm"
+if [ ! -f "$DATA" ]; then
+    python benchmarks/bench_pipeline.py gen "$DATA.tmp" "$ROWS" 28
+    mv "$DATA.tmp" "$DATA"
+fi
+
+# 3. interleaved single-threaded runs
+echo "== interleaved A/B, nthread=1, $REPS reps each"
+for i in $(seq "$REPS"); do
+    echo "-- rep $i"
+    ref_line=$("$WORK/libsvm_parser_test" "$DATA" 0 1 1 2>/dev/null | tail -1)
+    [ -n "$ref_line" ] || { echo "reference harness produced no output" >&2; exit 1; }
+    echo "reference: $ref_line"
+    python benchmarks/bench_pipeline.py parser "$DATA" libsvm 1 2>/dev/null \
+        | tail -1 | sed 's/^/ours:      /'
+done

@@ -16,7 +16,10 @@ collectives over ICI:
   back.  Right when heads >= devices and full-sequence kernels are preferred.
 
 Both are exact (match full attention to float tolerance) and jit-compiled via
-shard_map over a named mesh axis.
+shard_map over a named mesh axis.  Both are differentiable — jax autodiff
+composes through the ppermute scan / all_to_all, and the gradients match
+full-attention gradients (tests/test_sequence.py) — so long-context
+TRAINING, not just inference, rides these paths.
 """
 
 from __future__ import annotations
