@@ -59,6 +59,7 @@ def main() -> int:
     # CLI entry-point modules are exempt (they talk to the terminal)
     cli_modules = {os.path.join(ROOT, "dmlc_core_tpu", "tracker", p)
                    for p in ("submit.py", "launcher.py")}
+    cli_modules.add(os.path.join(ROOT, "dmlc_core_tpu", "io", "__main__.py"))
     for path in files:
         if not path.startswith(os.path.join(ROOT, "dmlc_core_tpu")):
             continue

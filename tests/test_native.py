@@ -215,3 +215,24 @@ def test_csv_empty_cells_parity(tmp_path):
     content = b"1,0.5,,2.0\n0,,1.5,\n,,,\n3,4,5,6\n"
     # native path errors must match python: both accept empty cells as 0
     assert_native_matches_python(tmp_path, content, "csv", "empty.csv")
+
+
+def test_float_fastpath_boundary_semantics():
+    """The fast-path float parser must take the same accept/reject decision
+    as std::from_chars at every seam: FLT_MAX edge, denormal edge, the
+    e+-22 table boundary, long mantissas, and exotic spellings."""
+    from dmlc_core_tpu.native_bridge import parse_libsvm
+
+    accept = ["1e22", "1e-22", "1e23", "1e-23", "9.9999e21", "-1e22",
+              "123456789012345678", "1234567890123456789",
+              "0.000000000000000001", ".5e21", "5.e-21",
+              "3.4028235e38", "1e-45", "1.4e-45",
+              "2.", ".5", "-0.0", "0", "-0", "1e0", "1E+5", "1e-0",
+              "00001.5000"]
+    reject = ["3.4028236e38",   # > FLT_MAX: from_chars out_of_range
+              "1e-46"]          # underflow: from_chars out_of_range
+    for tok in accept:
+        parse_libsvm(f"1 0:{tok}\n".encode(), 1)   # must not raise
+    for tok in reject:
+        with pytest.raises(Exception):
+            parse_libsvm(f"1 0:{tok}\n".encode(), 1)
