@@ -159,12 +159,22 @@ class RecordingClient:
         wire.sendint(self.port, tag=PORT)    # our advertised listener
 
     # -- scripted sessions ---------------------------------------------------
-    def session_start(self, world=-1):
-        wire = self._connect()
-        self._handshake(wire, "start", rank=-1, world=world)
+    def begin_start(self, world=-1):
+        """Connect and send the full request header NOW (cheap, non-blocking
+        writes) so arrival order at the tracker is fixed by call order; the
+        blocking response half runs later in :meth:`finish_start`."""
+        self._wire = self._connect()
+        self._handshake(self._wire, "start", rank=-1, world=world)
+
+    def finish_start(self):
+        wire = self._wire
         self._read_topology(wire)
         self._broker(wire)
         wire.sock.close()
+
+    def session_start(self, world=-1):
+        self.begin_start(world)
+        self.finish_start()
 
     def session_recover(self, rank):
         """Reconnect as an already-ranked worker whose links all survived
@@ -210,18 +220,17 @@ def drive_session(tracker_addr, n, jobids=None, with_recover=False,
     clients = [RecordingClient(tracker_addr,
                                jobid=(jobids[i] if jobids else "NULL"))
                for i in range(n)]
-    # serialized arrival: each start runs in a thread (the tracker answers
-    # client 0's brokering only after all arrive), but the request headers
-    # are sent in strict client order so rank assignment is deterministic.
+    # deterministic arrival by construction: every header is connected and
+    # sent from THIS thread in client order (tiny non-blocking writes), so
+    # the tracker assigns ranks in exactly that order; only the blocking
+    # response halves (topology read + brokering) run in threads.
+    for c in clients:
+        c.begin_start()
     threads = []
     for c in clients:
-        t = threading.Thread(target=c.session_start, daemon=True)
+        t = threading.Thread(target=c.finish_start, daemon=True)
         t.start()
         threads.append(t)
-        # the header is tiny (fits any socket buffer), so a short pause
-        # guarantees its bytes are queued before the next client connects
-        import time
-        time.sleep(0.05)
     for t in threads:
         t.join(timeout=30)
         assert not t.is_alive(), "rendezvous hung"
@@ -291,13 +300,13 @@ def test_jobid_restart_matches_reference():
     def scripted(addr, n):
         jobids = [f"job-{i}" for i in range(n)]
         clients = [RecordingClient(addr, jobid=jobids[i]) for i in range(n)]
-        threads = []
-        import time
         for c in clients:
-            t = threading.Thread(target=c.session_start, daemon=True)
+            c.begin_start()
+        threads = []
+        for c in clients:
+            t = threading.Thread(target=c.finish_start, daemon=True)
             t.start()
             threads.append(t)
-            time.sleep(0.05)
         for t in threads:
             t.join(timeout=30)
             assert not t.is_alive()
