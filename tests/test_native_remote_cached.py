@@ -298,3 +298,34 @@ def test_remote_indexed_recordio_span_reader(mock_s3):
             assert sorted(got) == sorted(records) and got != records
         else:
             assert got == records
+
+
+def test_remote_mid_epoch_reset_repeats(mock_s3):
+    """Port of the reference's split_repeat_read_test.cc protocol, run over
+    the remote callback engine: read nmax records, BeforeFirst MID-EPOCH
+    (the producer thread is still live and mid-read — exactly the window
+    the Invalidate() reopen sentinel must handle race-free), verify the
+    prefix repeats, finish the epoch, reset again, verify the whole epoch
+    repeats byte-for-byte."""
+    lines = [b"line-%04d-%s" % (i, bytes([65 + i % 26]) * 24)
+             for i in range(600)]
+    mock_s3.objects[("bucket", "rep/p0.txt")] = b"\n".join(lines[:300]) + b"\n"
+    mock_s3.objects[("bucket", "rep/p1.txt")] = b"\n".join(lines[300:]) + b"\n"
+    from dmlc_core_tpu.io.input_split import create_input_split
+
+    for nmax in (1, 37, 250):
+        split = create_input_split("s3://bucket/rep/p0.txt;s3://bucket/rep/p1.txt",
+                                   0, 1, "text")
+        prefix = []
+        for _ in range(nmax):
+            r = split.next_record()
+            assert r is not None
+            prefix.append(bytes(r))
+        split.before_first()                      # mid-epoch reset
+        full = _records_noclose(split)
+        assert full[:nmax] == prefix
+        assert full == lines
+        split.before_first()                      # reset after full epoch
+        again = _records_noclose(split)
+        split.close()
+        assert again == full

@@ -436,3 +436,30 @@ def test_indexed_native_randomized_property(tmp_path):
             assert nat == py, ctx
             flat = [r for p_ in nat for r in p_]
             assert sorted(flat) == sorted(records), ctx
+
+
+def test_mid_epoch_reset_repeats(tmp_path):
+    """split_repeat_read_test.cc protocol (reference test/): partial read,
+    BeforeFirst while the prefetch producer is mid-epoch, prefix must
+    repeat; then a full epoch and one more reset must reproduce it
+    byte-for-byte."""
+    lines = [b"rec-%04d-%s" % (i, bytes([97 + i % 26]) * 40)
+             for i in range(500)]
+    uri = _write_files(tmp_path, [b"\n".join(lines[:200]) + b"\n",
+                                  b"\n".join(lines[200:]) + b"\n"])
+    fs = fsys.LocalFileSystem()
+    for nmax in (1, 63, 400):
+        split = NativeLineSplitter(fs, uri, 0, 1)
+        prefix = []
+        for _ in range(nmax):
+            r = split.next_record()
+            assert r is not None
+            prefix.append(bytes(r))
+        split.before_first()
+        full = _records_noclose(split)
+        assert full[:nmax] == prefix
+        assert full == lines
+        split.before_first()
+        split_again = _records_noclose(split)
+        split.close()
+        assert split_again == full
