@@ -31,9 +31,14 @@ def main():
     ap.add_argument("--learning-rate", type=float, default=1e-3)
     ap.add_argument("--model-parallel", type=int, default=1,
                     help="mesh width of the 'model' axis for tp layers")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="resumable training: epoch-numbered checkpoints "
+                         "(params + optimizer state); rerunning with the "
+                         "same dir resumes at the latest epoch")
     args = ap.parse_args()
 
     import jax
+    import numpy as np
 
     from dmlc_core_tpu import collective
     from dmlc_core_tpu.bridge.loader import MeshBatchLoader
@@ -59,13 +64,40 @@ def main():
     params = model.init_params()
     opt_state = model.init_optimizer(params)
 
+    mgr = None
+    start_epoch = 0
+    if args.checkpoint_dir:
+        from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(args.checkpoint_dir, keep=3)
+        latest = mgr.latest_step()
+        if nparts > 1:
+            # rank 0 is the writer: every rank must see ITS view of the
+            # store agree with rank 0's, otherwise --checkpoint-dir is not
+            # shared storage and ranks would resume at different epochs
+            # (desynchronized collectives deadlock). Fail loudly instead.
+            agreed = int(collective.broadcast(
+                np.int64(-1 if latest is None else latest), root=0))
+            mine = -1 if latest is None else latest
+            if agreed != mine:
+                raise SystemExit(
+                    f"--checkpoint-dir must be shared storage: rank "
+                    f"{part} sees step {mine} but rank 0 sees {agreed}")
+        if latest is not None:
+            # template restore keeps the params/opt pytree structure
+            params, opt_state = mgr.restore(
+                latest, template=(params, opt_state))
+            start_epoch = latest
+            collective.tracker_print(
+                f"resuming from checkpoint epoch {latest}")
+
     parser = create_parser(args.data, part, nparts, type="auto")
     meter = ThroughputMeter("train")
     with mesh:
         loader = MeshBatchLoader(parser, mesh, form="dense",
                                  global_batch_size=args.batch_size,
                                  num_feature=args.num_feature)
-        for epoch in range(args.epochs):
+        for epoch in range(start_epoch, args.epochs):
             loss = None
             for batch in loader:
                 params, opt_state, loss = model.train_step(params, opt_state,
@@ -77,6 +109,11 @@ def main():
             if loss is not None:
                 collective.tracker_print(
                     f"epoch {epoch}: loss={float(loss):.5f}")
+            if mgr is not None and (epoch + 1) < args.epochs:
+                if part == 0:
+                    mgr.save(epoch + 1, (params, opt_state))
+        if mgr is not None:
+            mgr.wait_until_finished()
         loader.close()
     print(meter.summary())
 

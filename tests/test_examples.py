@@ -147,3 +147,30 @@ def test_train_gbdt_resumable_checkpoints(tmp_path):
     assert "resuming from checkpoint step 2" in proc.stdout
     # throughput honesty: the resumed run reports only the rounds IT trained
     assert "trained 4 rounds" in proc.stdout
+
+
+@pytest.mark.slow
+def test_train_mlp_resumable_checkpoints(tmp_path):
+    """--checkpoint-dir on the MLP example: params + optimizer state
+    round-trip through CheckpointManager's template restore; a rerun with
+    more epochs resumes rather than restarting."""
+    rng = np.random.RandomState(5)
+    lines = []
+    for i in range(512):
+        x = rng.randn(8)
+        y = int(x[0] + x[3] > 0)
+        feats = " ".join(f"{j}:{x[j]:.4f}" for j in range(8))
+        lines.append(f"{y} {feats}")
+    data = tmp_path / "train.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+    ckpt = tmp_path / "ckpts"
+    script = os.path.join(REPO, "examples", "train_mlp.py")
+    base = ["--data", str(data), "--num-feature", "8", "--batch-size",
+            "128", "--checkpoint-dir", str(ckpt)]
+    proc = run_example(script, base + ["--epochs", "2"])
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert (ckpt / "ckpt-00000001").exists()
+    proc = run_example(script, base + ["--epochs", "3"])
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = proc.stdout + proc.stderr
+    assert "resuming from checkpoint epoch 1" in out
