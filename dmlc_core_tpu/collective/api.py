@@ -123,8 +123,11 @@ def finalize() -> None:
             jax.distributed.shutdown()
         except Exception:
             pass
+    # version resets with the session: a re-init is a fresh job whose
+    # restart recovery (load_checkpoint's version discovery) must not
+    # inherit a dead session's counter
     _state.update(initialized=False, distributed=False, mesh=None,
-                  fn_cache={})
+                  fn_cache={}, version=0)
 
 
 def is_initialized() -> bool:
@@ -313,6 +316,12 @@ def version_number() -> int:
     return _state["version"]
 
 
+def _check_version_template(uri_template: str) -> None:
+    CHECK(uri_template.format(version=1) != uri_template.format(version=2),
+          "checkpoint uri_template must contain a {version} placeholder, "
+          f"got {uri_template!r}")
+
+
 def checkpoint(model: Any, uri_template: str = "") -> None:
     """Persist a model pytree for failure recovery (rabit::Checkpoint).
 
@@ -323,21 +332,115 @@ def checkpoint(model: Any, uri_template: str = "") -> None:
     if uri_template and get_rank() == 0:
         from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
 
+        _check_version_template(uri_template)
         save_checkpoint(uri_template.format(version=_state["version"]), model)
 
 
-def load_checkpoint(uri_template: str = "", version: Optional[int] = None) -> Any:
-    """Load the checkpoint saved by :func:`checkpoint`; None when absent."""
+def load_checkpoint(uri_template: str = "", version: Optional[int] = None,
+                    template: Any = None) -> Any:
+    """Load the checkpoint saved by :func:`checkpoint`; None when absent.
+
+    Like rabit's ``LoadCheckPoint``, a freshly restarted worker (version
+    counter still 0) does not need to know which round died: rank 0
+    discovers the latest version on the store (exponential ascent + binary
+    search — O(log N) probes), falls back past a corrupt newest version,
+    and BROADCASTS both the version and the model leaves to every rank, so
+    ranks can never resume desynchronized even when the store is only
+    reachable from rank 0 (this is the part of rabit's recovery that came
+    from a surviving peer).  Multi-process recovery therefore requires
+    ``template`` (the pytree structure to rebuild on non-root ranks).
+    """
     if not uri_template:
         return None
-    from dmlc_core_tpu.bridge.checkpoint import load_checkpoint as _load
+    _check_version_template(uri_template)
+    world = get_world_size() if _state["initialized"] else 1
+    rank = get_rank() if _state["initialized"] else 0
+    multi = world > 1
+    if multi:
+        CHECK(template is not None,
+              "multi-process load_checkpoint needs template= (non-root "
+              "ranks rebuild the model from broadcast leaves)")
 
     ver = version if version is not None else _state["version"]
-    if ver <= 0:
-        return None
-    try:
-        model = _load(uri_template.format(version=ver))
-    except (OSError, IOError):
+    model = None
+    if rank == 0 or not multi:
+        if ver <= 0:
+            ver = _discover_latest_version(uri_template)
+        model, ver = _load_with_fallback(uri_template, ver, template)
+    if multi:
+        ver = int(broadcast(np.int64(ver if rank == 0 else 0), root=0))
+        if ver > 0:
+            import jax
+
+            leaves, treedef = jax.tree_util.tree_flatten(template)
+            src_leaves = (jax.tree_util.tree_leaves(model) if rank == 0
+                          else [np.zeros_like(np.asarray(l))
+                                for l in leaves])
+            model = jax.tree_util.tree_unflatten(
+                treedef, [broadcast(np.asarray(s), root=0)
+                          for s in src_leaves])
+    if ver <= 0 or model is None:
         return None
     _state["version"] = ver
     return model
+
+
+def _discover_latest_version(uri_template: str) -> int:
+    """Largest contiguous existing version: exponential ascent to bracket,
+    then binary search — O(log N) store probes instead of N."""
+    if not _checkpoint_exists(uri_template, 1):
+        return 0
+    lo = 1                      # known to exist
+    hi = 2
+    while _checkpoint_exists(uri_template, hi):
+        lo, hi = hi, hi * 2
+    # invariant: lo exists, hi does not; find the boundary
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if _checkpoint_exists(uri_template, mid):
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+def _load_with_fallback(uri_template: str, ver: int, template: Any):
+    """Load version ``ver``, falling back past corrupt/truncated newer
+    versions (a remote store without atomic rename can expose a partial
+    newest file — same policy as CheckpointManager.restore)."""
+    from dmlc_core_tpu.bridge.checkpoint import load_checkpoint as _load
+
+    last_err: Optional[BaseException] = None
+    while ver > 0:
+        try:
+            return _load(uri_template.format(version=ver), template), ver
+        except Exception as e:  # noqa: BLE001 — fall back past bad versions
+            log_info(f"checkpoint version {ver} unreadable ({e}); "
+                     "falling back to previous version")
+            last_err = e
+            ver -= 1
+    if last_err is not None and not _checkpoint_exists(uri_template, 1):
+        # nothing restorable at all, and version 1 is genuinely absent:
+        # treat as a fresh start rather than an error
+        return None, 0
+    if last_err is not None:
+        raise RuntimeError(
+            f"no restorable checkpoint for {uri_template!r}") from last_err
+    return None, 0
+
+
+def _checkpoint_exists(uri_template: str, version: int) -> bool:
+    """Existence probe.  Only genuinely-missing paths count as absent;
+    transient store errors (auth, network) must PROPAGATE — treating them
+    as 'absent' would silently roll training back to an older version and
+    later overwrite newer checkpoints with stale state."""
+    from dmlc_core_tpu.io.stream import create_stream_for_read
+
+    try:
+        s = create_stream_for_read(uri_template.format(version=version))
+    except (FileNotFoundError, IsADirectoryError):
+        return False
+    if s is None:
+        return False
+    s.close()
+    return True

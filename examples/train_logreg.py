@@ -55,9 +55,21 @@ def main():
     model = LinearModel(LinearParam(num_feature=args.num_feature,
                                     learning_rate=args.learning_rate))
     params = model.init_params()
+    start_epoch = 0
+    if args.checkpoint:
+        # rabit-style restart recovery: a fresh process discovers the
+        # latest version on the store (collective.load_checkpoint) and
+        # resumes; version N == N epochs completed
+        restored = collective.load_checkpoint(args.checkpoint,
+                                              template=params)
+        if restored is not None:
+            params = restored
+            start_epoch = collective.version_number()
+            collective.tracker_print(
+                f"resuming from checkpoint version {start_epoch}")
     meter = ThroughputMeter("train")
     loss = None
-    for epoch in range(args.epochs):
+    for epoch in range(start_epoch, args.epochs):
         if epoch:
             loader.before_first()
         for batch in loader:

@@ -179,3 +179,93 @@ def test_single_process_broadcast_requires_root_value():
             collective.broadcast(None, root=0)
     finally:
         collective.finalize()
+
+
+def test_checkpoint_restart_discovers_latest_version(tmp_path):
+    """rabit LoadCheckPoint semantics: a freshly restarted process (version
+    counter 0) recovers the newest checkpoint version without being told
+    which round died, and the counter resumes from it."""
+    from dmlc_core_tpu import collective
+
+    tmpl = str(tmp_path / "ck-{version}.bin")
+    collective.init()
+    try:
+        state = {"w": np.arange(4, dtype=np.float32)}
+        for v in range(3):                     # writes versions 1..3
+            state["w"] = state["w"] + 1
+            collective.checkpoint(state, tmpl)
+        assert collective.version_number() == 3
+    finally:
+        collective.finalize()
+
+    # "restart": fresh runtime, version counter back at 0
+    collective.init()
+    try:
+        assert collective.version_number() == 0
+        restored = collective.load_checkpoint(
+            tmpl, template={"w": np.zeros(4, np.float32)})
+        assert restored is not None
+        np.testing.assert_array_equal(restored["w"],
+                                      np.arange(4, dtype=np.float32) + 3)
+        assert collective.version_number() == 3   # counter resumed
+        # next checkpoint continues the sequence
+        collective.checkpoint(restored, tmpl)
+        assert (tmp_path / "ck-4.bin").exists()
+    finally:
+        collective.finalize()
+
+
+def test_load_checkpoint_absent_returns_none(tmp_path):
+    from dmlc_core_tpu import collective
+
+    collective.init()
+    try:
+        assert collective.load_checkpoint(
+            str(tmp_path / "none-{version}.bin")) is None
+    finally:
+        collective.finalize()
+
+
+MP_RESTART_WORKER = r"""
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dmlc_core_tpu import collective
+
+collective.init()
+rank = collective.get_rank()
+tmpl = os.environ["CKPT_TEMPLATE"]
+template = {"w": np.zeros(3, np.float32)}
+restored = collective.load_checkpoint(tmpl, template=template)
+phase = os.environ["PHASE"]
+if phase == "fresh":
+    assert restored is None, restored
+    state = {"w": np.arange(3, dtype=np.float32)}
+    collective.checkpoint(state, tmpl)        # version 1 (rank 0 writes)
+else:
+    # every rank must see the SAME broadcast state, even though only
+    # rank 0 reads the store
+    assert restored is not None
+    np.testing.assert_array_equal(restored["w"],
+                                  np.arange(3, dtype=np.float32))
+    assert collective.version_number() == 1
+    with open(os.environ["RESULT_DIR"] + f"/ok-{rank}", "w") as f:
+        f.write("ok")
+collective.finalize()
+"""
+
+
+@pytest.mark.slow
+def test_multiprocess_restart_recovery_broadcasts(tmp_path):
+    """rabit-style restart across processes: rank 0 discovers + loads the
+    latest version and broadcasts it; every rank resumes identically."""
+    from tests.conftest import run_tracker_workers
+
+    tmpl = str(tmp_path / "mp-{version}.bin")
+    for phase in ("fresh", "restart"):
+        proc = run_tracker_workers(tmp_path, MP_RESTART_WORKER, 2,
+                                   env_extra={"CKPT_TEMPLATE": tmpl,
+                                              "PHASE": phase})
+        assert proc.returncode == 0, proc.stderr[-3000:]
+    assert (tmp_path / "ok-0").exists() and (tmp_path / "ok-1").exists()
