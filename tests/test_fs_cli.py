@@ -112,3 +112,29 @@ def test_cp_failure_leaves_no_partial_local_dest(tmp_path, capsys):
     rc = main(["cp", str(tmp_path / "missing.bin"), str(dst)])
     assert rc == 1
     assert not dst.exists()
+
+
+@pytest.fixture()
+def mock_azure(monkeypatch):
+    import base64
+
+    from tests.test_azure import MockAzure
+
+    server = MockAzure().start()
+    monkeypatch.setenv("AZURE_STORAGE_ACCOUNT", "testacct")
+    monkeypatch.setenv("AZURE_STORAGE_ACCESS_KEY",
+                       base64.b64encode(b"secret-key").decode())
+    monkeypatch.setenv("AZURE_ENDPOINT", f"http://127.0.0.1:{server.port}")
+    yield server
+    server.stop()
+
+
+def test_cp_and_cat_azure(mock_azure, tmp_path, capsys):
+    """The CLI rides the same env creds contract on azure:// too."""
+    src = tmp_path / "a.bin"
+    payload = b"azure cli payload " * 64
+    src.write_bytes(payload)
+    assert main(["cp", str(src), "azure://cont/dir/a.bin"]) == 0
+    assert mock_azure.blobs[("cont", "dir/a.bin")] == payload
+    assert main(["cat", "azure://cont/dir/a.bin"]) == 0
+    assert capsys.readouterr().out.encode() == payload
