@@ -4,7 +4,7 @@
 Reads csv or libsvm (dense features), quantile-bins on a sample, trains
 boosted trees in a single compiled program, reports accuracy and rows/sec::
 
-    python examples/train_gbdt.py --data higgs.csv?format=csv&label_column=0 \
+    python examples/train_gbdt.py --data 'higgs.csv?format=csv&label_column=0' \
         --num-feature 28 --rounds 50 --max-depth 6
 """
 
