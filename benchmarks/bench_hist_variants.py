@@ -17,8 +17,23 @@ import numpy as np
 
 
 
-def fit_time(model, method, bins, y, rounds):
-    """Warm-compile then best-of-3 full-fit wall clock on the default device."""
+def counterfactual_gate(rows):
+    """Off-chip: interpret the pallas kernels (no Mosaic) and shrink the
+    workload so the script EXECUTES for pre-chip bitrot validation; the
+    timings are meaningless there and reps drop to 1."""
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        return rows, 3
+    os.environ.setdefault("DMLC_TPU_PALLAS_INTERPRET", "1")
+    capped = min(rows, 2000)
+    print(f"platform={jax.devices()[0].platform} (NOT TPU - "
+          f"counterfactual; rows capped at {capped})")
+    return capped, 1
+
+
+def fit_time(model, method, bins, y, rounds, reps=3):
+    """Warm-compile then best-of-N full-fit wall clock on the default device."""
     import jax
 
     dev = jax.devices()[0]
@@ -29,7 +44,7 @@ def fit_time(model, method, bins, y, rounds):
     _, m = fit(b, yy, ww)
     jax.block_until_ready(m)
     best = float("inf")
-    for _ in range(3):
+    for _ in range(reps):
         t0 = time.perf_counter()
         _, m = fit(b, yy, ww)
         jax.block_until_ready(m)
@@ -40,6 +55,8 @@ def fit_time(model, method, bins, y, rounds):
 def main():
     rows = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
     import jax
+
+    rows, reps = counterfactual_gate(rows)
 
     from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
     from dmlc_core_tpu.ops import hist_pallas
@@ -59,7 +76,7 @@ def main():
           f"i8_supported={hist_pallas.pallas_i8_supported()}")
 
     for method in ("pallas", "pallas_fused", "onehot"):
-        dt = fit_time(model, method, bins, y, R)
+        dt = fit_time(model, method, bins, y, R, reps=reps)
         print(f"{method:13s}: {dt * 1e3:7.1f} ms  "
               f"{rows * R / dt / 1e6:6.2f}M rows/s")
         # fresh compilation caches per method set are keyed by method only;
@@ -71,7 +88,7 @@ def main():
 
 def deep_tree_ab(rows=100_000):
     """Depth-10 A/B: node-blocked pallas sweeps vs the onehot fallback."""
-    import jax
+    rows, reps = counterfactual_gate(rows)
 
     from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
     from dmlc_core_tpu.ops.histogram import apply_bins
@@ -85,7 +102,7 @@ def deep_tree_ab(rows=100_000):
     model.make_bins(x[:50_000])
     bins = np.asarray(apply_bins(x, model.boundaries)).astype(np.int32)
     for method in ("pallas", "onehot"):
-        best = fit_time(model, method, bins, y, R)
+        best = fit_time(model, method, bins, y, R, reps=reps)
         print(f"depth-10 {method:7s}: {best * 1e3:7.1f} ms  "
               f"{rows * R / best / 1e6:6.2f}M rows/s")
 
