@@ -72,6 +72,71 @@ def _fit_resumable(model, param, bins, y, args):
     return ensemble, np.asarray(gmargin), secs, args.rounds - start
 
 
+def _fit_distributed(model, bins, y, collective):
+    """One GLOBAL data-parallel fit across the worker world (the
+    tests/test_distributed_gbdt.py path as a user-facing CLI): rows are
+    sharded across processes on a global mesh, histogram aggregation
+    compiles to collectives, and every rank holds the SAME ensemble.
+
+    Ranks' shard sizes differ by up to a row after InputSplit partitioning,
+    so every rank pads to the max local count with weight-0 rows — inert in
+    the histogram (zero grad/hess mass).  Returns (ensemble, acc, secs,
+    global_rows).
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from dmlc_core_tpu.parallel.mesh import data_sharding, make_mesh
+
+    n_local = len(y)
+    n_max = int(collective.allreduce(np.asarray([n_local]), op="max")[0])
+    # the global dim (n_max * world) must shard evenly over ALL devices
+    # (world * local_device_count), so round the per-rank count up to a
+    # multiple of the local device count (multi-chip hosts: 4 devices/host)
+    ldc = jax.local_device_count()
+    n_max = -(-n_max // ldc) * ldc
+    pad = n_max - n_local
+    F = bins.shape[1]
+    if pad:
+        bins = np.concatenate([bins, np.zeros((pad, F), bins.dtype)])
+        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+    w = np.ones(n_max, np.float32)
+    if pad:
+        w[n_local:] = 0.0
+    world = collective.get_world_size()
+    B = n_max * world
+    mesh = make_mesh()
+    sh2 = data_sharding(mesh, ndim=2)
+    sh1 = data_sharding(mesh, ndim=1)
+    gbins = jax.make_array_from_process_local_data(sh2, bins, (B, F))
+    glabel = jax.make_array_from_process_local_data(
+        sh1, np.asarray(y, np.float32), (B,))
+    gw = jax.make_array_from_process_local_data(sh1, w, (B,))
+    with mesh:
+        ens, margin = model.fit_binned(gbins, glabel, weight=gw)  # warm
+        jax.block_until_ready(margin)
+        t0 = time.perf_counter()
+        ens, margin = model.fit_binned(gbins, glabel, weight=gw)
+        jax.block_until_ready(margin)
+        secs = time.perf_counter() - t0
+        if model.param.objective == "softmax":
+            hit = (jnp.argmax(margin, axis=1) == glabel)
+        else:
+            hit = ((margin > 0) == glabel)
+        total_w = jnp.sum(gw)          # == global REAL row count (pads are 0)
+        acc = float(jnp.sum(hit * gw) / total_w)
+        global_rows = int(round(float(total_w)))
+        # materialize the (small) ensemble on every host: an explicit
+        # replicated out-sharding inserts the all-gather
+        from dmlc_core_tpu.parallel.mesh import replicated_sharding
+
+        rep = jax.jit(lambda a: a, out_shardings=replicated_sharding(mesh))
+        ens = jax.tree_util.tree_map(lambda a: np.asarray(rep(a)), ens)
+    return ens, acc, secs, global_rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--data", required=True)
@@ -186,6 +251,25 @@ def main():
     bins = np.asarray(model.bin_features(x)).astype(np.int32)
 
     rounds_run = args.rounds
+    if nparts > 1:
+        # one GLOBAL model across the worker world; eval/resume flows are
+        # single-host features for now — error, never silently train
+        # per-shard models
+        if args.eval_data or args.checkpoint_dir:
+            ap.error("--eval-data/--checkpoint-dir are single-host flows; "
+                     "under a multi-worker launch the fit is one global "
+                     "data-parallel program")
+        ensemble, acc, secs, global_rows = _fit_distributed(
+            model, bins, y, collective)
+        rows_per_sec = global_rows * rounds_run / secs
+        print(f"trained {rounds_run} rounds on {global_rows} rows over "
+              f"{nparts} workers in {secs:.2f}s ({rows_per_sec:,.0f} "
+              f"rows/sec), train acc {acc:.4f}")
+        if args.checkpoint and part == 0:
+            save_checkpoint(args.checkpoint, ensemble._asdict())
+            print(f"checkpoint written to {args.checkpoint}")
+        collective.finalize()
+        return
     if args.checkpoint_dir:
         if args.eval_data or args.early_stopping_rounds:
             ap.error("--checkpoint-dir cannot be combined with --eval-data/"

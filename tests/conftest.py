@@ -24,9 +24,10 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def run_tracker_workers(tmp_path, script_text, nworkers, env_extra=None,
-                        timeout=600):
-    """Shared multi-process launch recipe: write a worker script, run it
-    under `dmlc-submit --cluster local`, return the CompletedProcess.
+                        timeout=600, script_path=None, script_args=()):
+    """Shared multi-process launch recipe: write a worker script (or use an
+    existing one via ``script_path`` + ``script_args``), run it under
+    `dmlc-submit --cluster local`, return the CompletedProcess.
 
     Used by the tracker/collective/distributed-model e2e tests so the env
     hygiene (CPU forcing, PYTHONPATH, XLA_FLAGS scrubbing, RESULT_DIR)
@@ -36,8 +37,9 @@ def run_tracker_workers(tmp_path, script_text, nworkers, env_extra=None,
     import sys
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "worker.py"
-    script.write_text(script_text)
+    if script_path is None:
+        script_path = tmp_path / "worker.py"
+        script_path.write_text(script_text)
     env = os.environ.copy()
     env["RESULT_DIR"] = str(tmp_path)
     env["JAX_PLATFORMS"] = "cpu"
@@ -46,6 +48,6 @@ def run_tracker_workers(tmp_path, script_text, nworkers, env_extra=None,
     env.update(env_extra or {})
     cmd = [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
            "--cluster", "local", "--num-workers", str(nworkers), "--",
-           sys.executable, str(script)]
+           sys.executable, str(script_path), *map(str, script_args)]
     return subprocess.run(cmd, env=env, cwd=repo, capture_output=True,
                           text=True, timeout=timeout)

@@ -174,3 +174,34 @@ def test_train_mlp_resumable_checkpoints(tmp_path):
     assert proc.returncode == 0, proc.stderr[-3000:]
     out = proc.stdout + proc.stderr
     assert "resuming from checkpoint epoch 1" in out
+
+
+@pytest.mark.slow
+def test_train_gbdt_distributed_cli(tmp_path):
+    """Under a multi-worker launch the GBDT CLI trains ONE global
+    data-parallel model (not N per-shard models) and reports the global
+    row count; rank 0 writes the final checkpoint."""
+    rng = np.random.RandomState(11)
+    lines = []
+    for i in range(1000):
+        x = rng.randn(6)
+        y = int(x[0] + x[1] > 0)
+        feats = " ".join(f"{j}:{x[j]:.4f}" for j in range(6))
+        lines.append(f"{y} {feats}")
+    data = tmp_path / "train.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+    ckpt = tmp_path / "model.bin"
+    from tests.conftest import run_tracker_workers
+
+    proc = run_tracker_workers(
+        tmp_path, None, 2,
+        script_path=os.path.join(REPO, "examples", "train_gbdt.py"),
+        script_args=["--data", str(data), "--num-feature", "6", "--rounds",
+                     "4", "--max-depth", "3", "--num-bins", "16",
+                     "--hist-method", "scatter", "--checkpoint", str(ckpt)])
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    out = proc.stdout + proc.stderr
+    # both ranks print the SAME global summary (one SPMD program)
+    assert out.count("over 2 workers") == 2, out[-2000:]
+    assert "on 1000 rows" in out
+    assert ckpt.exists()
