@@ -60,6 +60,12 @@ inline const char* skip_ws(const char* p, const char* end) {
   return p;
 }
 
+// between-rows variant: newlines (and blank lines) are inter-row space
+inline const char* skip_ws_nl(const char* p, const char* end) {
+  while (p != end && (is_ws(*p) || *p == '\n')) ++p;
+  return p;
+}
+
 inline bool parse_float_slow(const char*& p, const char* end, float* out) {
   auto res = std::from_chars(p, end, *out);
   if (res.ec != std::errc()) return false;
@@ -264,7 +270,7 @@ void parse_libsvm_range(const char* begin, const char* end, Shard* s) {
   // single pass, no per-line memchr: '\n' is just another terminator the
   // number scanners already stop at, so every byte is touched once
   while (p < end) {
-    while (p < end && (is_ws(*p) || *p == '\n')) ++p;  // blank lines too
+    p = skip_ws_nl(p, end);  // blank lines too
     if (p >= end) break;
     float label;
     if (!parse_float(p, end, &label)) {
@@ -285,7 +291,7 @@ void parse_libsvm_range(const char* begin, const char* end, Shard* s) {
     int64_t nnz = 0;
     while (true) {
       if (p < end && *p == ' ') ++p;      // the common single separator
-      while (p < end && is_ws(*p)) ++p;
+      p = skip_ws(p, end);
       if (p >= end || *p == '\n') break;
       uint32_t idx;
       if (!parse_u32(p, end, &idx)) {
@@ -324,63 +330,60 @@ void parse_libfm_range(const char* begin, const char* end, Shard* s) {
   s->field.reserve(len / 8);
   s->index.reserve(len / 8);
   s->value.reserve(len / 8);
+  bool any_weight = false;
+  // one pass, no per-line memchr (same restructure as the libsvm loop)
   while (p < end) {
-    const char* lend = static_cast<const char*>(memchr(p, '\n', end - p));
-    if (!lend) lend = end;
-    p = skip_ws(p, lend);
-    if (p < lend) {
-      float label;
-      if (!parse_float(p, lend, &label)) {
+    p = skip_ws_nl(p, end);  // blank lines too
+    if (p >= end) break;
+    float label;
+    if (!parse_float(p, end, &label)) {
+      s->error = true;
+      s->error_msg = "invalid label in libfm input";
+      return;
+    }
+    float w = 1.0f;
+    if (p < end && *p == ':') {
+      ++p;
+      if (!parse_float(p, end, &w)) {
         s->error = true;
-        s->error_msg = "invalid label in libfm input";
+        s->error_msg = "invalid weight in libfm input";
         return;
       }
-      float w = 1.0f;
-      bool has_w = false;
-      if (p < lend && *p == ':') {
-        ++p;
-        if (!parse_float(p, lend, &w)) {
-          s->error = true;
-          s->error_msg = "invalid weight in libfm input";
-          return;
-        }
-        has_w = true;
-      }
-      int64_t nnz = 0;
-      while (true) {
-        p = skip_ws(p, lend);
-        if (p >= lend) break;
-        uint32_t fld, idx;
-        float v;
-        if (!parse_u32(p, lend, &fld) || p >= lend || *p != ':') {
-          s->error = true;
-          s->error_msg = "libfm features must be field:index:value triples";
-          return;
-        }
-        ++p;
-        if (!parse_u32(p, lend, &idx) || p >= lend || *p != ':') {
-          s->error = true;
-          s->error_msg = "libfm features must be field:index:value triples";
-          return;
-        }
-        ++p;
-        if (!parse_float(p, lend, &v)) {
-          s->error = true;
-          s->error_msg = "invalid feature value in libfm input";
-          return;
-        }
-        s->field.push_back(fld);
-        s->index.push_back(idx);
-        s->value.push_back(v);
-        ++nnz;
-      }
-      s->label.push_back(label);
-      s->weight.push_back(w);
-      if (has_w) s->any_weight = true;
-      s->row_nnz.push_back(nnz);
+      any_weight = true;
     }
-    p = lend < end ? lend + 1 : end;
+    int64_t nnz = 0;
+    while (true) {
+      p = skip_ws(p, end);
+      if (p >= end || *p == '\n') break;
+      uint32_t fld, idx;
+      float v;
+      if (!parse_u32(p, end, &fld) || p >= end || *p != ':') {
+        s->error = true;
+        s->error_msg = "libfm features must be field:index:value triples";
+        return;
+      }
+      ++p;
+      if (!parse_u32(p, end, &idx) || p >= end || *p != ':') {
+        s->error = true;
+        s->error_msg = "libfm features must be field:index:value triples";
+        return;
+      }
+      ++p;
+      if (!parse_float(p, end, &v)) {
+        s->error = true;
+        s->error_msg = "invalid feature value in libfm input";
+        return;
+      }
+      s->field.push_back(fld);
+      s->index.push_back(idx);
+      s->value.push_back(v);
+      ++nnz;
+    }
+    s->label.push_back(label);
+    s->weight.push_back(w);
+    s->row_nnz.push_back(nnz);
   }
+  s->any_weight |= any_weight;
 }
 
 // ------------------------------------------------------------------- csv ----
@@ -401,11 +404,11 @@ void parse_csv_range(const char* begin, const char* end, CsvShard* s,
   // one pass, no per-line memchr: '\n' is just another cell terminator
   // (same restructure as the libsvm loop; every byte touched once)
   while (p < end) {
-    while (p < end && (is_ws(*p) || *p == '\n')) ++p;  // blank lines too
+    p = skip_ws_nl(p, end);  // blank lines too
     if (p >= end) break;
     int64_t cols = 0;
     while (true) {
-      while (p < end && is_ws(*p)) ++p;
+      p = skip_ws(p, end);
       float v;
       if (p == end || *p == ',' || *p == '\n') {
         // empty cell: the reference's strtof parses it as 0.0 silently
@@ -420,7 +423,7 @@ void parse_csv_range(const char* begin, const char* end, CsvShard* s,
       }
       s->dense.push_back(v);
       ++cols;
-      while (p < end && is_ws(*p)) ++p;
+      p = skip_ws(p, end);
       if (p < end && *p == ',') {
         ++p;
         continue;
