@@ -193,8 +193,8 @@ def run_bench(force_cpu):
     # (B*F*num_bins compare+accumulate lane-ops per level, m-independent).
     # Model that work and the HBM bytes actually streamed, so "VPU-bound"
     # is a checkable number: measured seconds ~= vpu_est_s >> hbm_est_s,
-    # and utilization = vpu_est_s / measured.  v5e-1 figures: 8 VPU lanes
-    # x 128 sublanes x ~0.94 GHz int32; ~819 GB/s HBM.
+    # and utilization = vpu_est_s / measured.  v5e-1 peak: 8 sublanes x
+    # 128 lanes x 4 ALUs per lane position @ ~0.94 GHz; ~819 GB/s HBM.
     # Roofline is a v5e-1 TPU model; off-chip it is meaningless (r4 VERDICT
     # weak #2: a CPU run carried "VPU utilization" in the official artifact),
     # so it is only emitted when the measurement actually ran on a TPU.
@@ -202,7 +202,12 @@ def run_bench(force_cpu):
     if platform == "tpu":
         levels = accel_rounds * MAX_DEPTH
         vpu_lane_ops = levels * N_ROWS * N_FEATURES * NUM_BINS * 2  # cmp+add
-        vpu_est_s = vpu_lane_ops / (8 * 128 * 0.94e9)
+        # v5e VPU peak: 8 sublanes x 128 lanes x 4 independent ALUs per lane
+        # position per cycle.  The r5 on-chip capture measured utilization
+        # 1.39 against a 1-ALU model (faster than that "bound"), which is
+        # how the missing ALU factor was caught — see BASELINE.md
+        # "Round-5 on-chip capture".
+        vpu_est_s = vpu_lane_ops / (8 * 128 * 4 * 0.94e9)
         n_pad = 16  # min node padding; W rows per level >= 2*n_pad
         hbm_bytes = levels * (
             N_ROWS * N_FEATURES * 4          # bins tile stream (int32)
@@ -214,7 +219,7 @@ def run_bench(force_cpu):
             "hbm_stream_est_s": round(hbm_est_s, 4),
             "vpu_utilization_vs_measured": round(
                 vpu_est_s / accel_s, 3) if accel_s else None,
-            "model": "levels*B*F*nbins*2 lane-ops / (8x128 lanes "
+            "model": "levels*B*F*nbins*2 lane-ops / (8x128 lanes x 4 ALUs "
                      "@0.94GHz); bytes: bins+W+hist per level @819GB/s "
                      "(v5e-1)",
         }

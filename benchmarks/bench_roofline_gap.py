@@ -1,8 +1,11 @@
 """Roofline-gap profile (r4 VERDICT item 7).
 
-bench.py's lane-op roofline says a 130 ms fast-mode fit sits at ~27% of the
-v5e-1 VPU bound — so either ~3.7x kernel headroom exists or the model is
-wrong.  This script separates the two by timing the pallas hist kernel IN
+The r5 on-chip capture answered the headline question — the 129 ms fit beat
+bench.py's old 1-ALU lane-op "bound" (utilization 1.39), so the MODEL was
+wrong: the v5e VPU retires multiple ALU ops per lane position per cycle.
+Both bounds here now use the 4-ALU peak (~35% measured utilization at the
+capture).  This script remains useful for the finer split: it times the
+pallas hist kernel IN
 ISOLATION at the exact shapes the bench fit uses per tree level, comparing
 that to (a) the lane-op bound for one level and (b) the measured per-level
 share of the full fit.  Three outcomes:
@@ -79,9 +82,12 @@ def main():
         jfn = jax.jit(lambda b, n, g, h, nn=num_nodes, f=fn:
                       f(b, n, g, h, nn, NBINS))
         t = bench_fn(jfn, bins, node_ids, grad, hess)
-        # one level of the roofline model: B*F*nbins*2 lane-ops
+        # one level of the roofline model: B*F*nbins*2 lane-ops against the
+        # v5e VPU peak of 8x128 lane positions x 4 ALUs (the r5 capture
+        # measured a fit FASTER than a 1-ALU bound, which is how the
+        # missing factor was caught — BASELINE.md "Round-5 on-chip capture")
         lane_ops = ROWS * F * NBINS * 2
-        bound_s = lane_ops / (8 * 128 * 0.94e9)
+        bound_s = lane_ops / (8 * 128 * 4 * 0.94e9)
         nb = hist_node_block(num_nodes, F, NBINS)
         print(f"depth={depth} nodes={num_nodes:2d} kernel={'fused' if use_fused else 'matmul'} "
               f"node_block={nb} t={t*1e3:7.2f} ms  lane-bound={bound_s*1e3:6.2f} ms  "
@@ -98,7 +104,7 @@ def main():
           f"{per_tree_kernel_s*1e3:.1f} ms"
           f"  -> x{ROUNDS} trees = {per_tree_kernel_s*ROUNDS*1e3:.1f} ms")
     print(f"fit lane-op bound (same {fit_levels} levels): "
-          f"{fit_levels*ROWS*F*NBINS*2/(8*128*0.94e9)*1e3:.1f} ms")
+          f"{fit_levels*ROWS*F*NBINS*2/(8*128*4*0.94e9)*1e3:.1f} ms")
     print("compare against the measured full-fit time from bench.py: the\n"
           "difference between (kernel-only x trees) and the full fit is\n"
           "inter-level overhead; the difference between kernel-only and the\n"

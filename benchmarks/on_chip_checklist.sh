@@ -10,6 +10,10 @@
 # additionally persists per-attempt JSON via BENCH_STAGE_DIR.
 set -u
 cd "$(dirname "$0")/.."
+# The package is imported from the source tree, not installed; scripts under
+# benchmarks/ need the repo root on sys.path (bench.py at the root gets it
+# for free, the rest do not).
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 RESULTS=benchmarks/results
 mkdir -p "$RESULTS"
 export BENCH_STAGE_DIR="$RESULTS"

@@ -1,8 +1,13 @@
 """Compiled eval fit on chip: one jit vs per-round host syncs through the
 tunnel (checklist step 5; extracted from the former heredoc)."""
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
 
 from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
 

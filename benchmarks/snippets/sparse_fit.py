@@ -1,10 +1,15 @@
 """Sparsity-aware fit on chip: full fit with 20% NaN + learned default
 directions (checklist step 4; extracted from the former heredoc so the
 checklist can run it under its own timeout/log)."""
+import os
+import sys
 import time
 
 import numpy as np
 import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
 
 from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
 
