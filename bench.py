@@ -107,22 +107,49 @@ def pipeline_smoke(tmpdir):
     assert rows == 2000, f"pipeline smoke failed: {rows}"
 
 
+def log_stage(msg):
+    """Timestamped progress marker on stderr: when a child exceeds its
+    wall-clock budget, the parent surfaces this trail so the timeout is
+    diagnosable (the r5 2M capture timed out with zero evidence of where
+    the 900s went — see BASELINE.md '2M anomaly')."""
+    print(f"[bench +{time.perf_counter() - _T0:8.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
 def time_fit(model, bins, y, rounds, device, method):
-    """Time fit with each backend's best hist algorithm."""
+    """Time fit with each backend's best hist algorithm.
+
+    `bins` may arrive as uint8 (the tunnel-frugal wire format — 4x fewer
+    bytes host->device than int32); it is widened on-device before the
+    timed region, so the fit itself always sees int32 exactly as before.
+    """
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     fit = model._fit_fn(rounds, method)
+    log_stage(f"transfer to {device.platform}: bins "
+              f"{bins.nbytes / 1e6:.0f} MB ({bins.dtype}) + labels")
     b = jax.device_put(bins, device)
     yy = jax.device_put(y, device)
     w = jax.device_put(np.ones(len(y), np.float32), device)
     with jax.default_device(device):
+        if b.dtype != jnp.int32:
+            b = jnp.asarray(b, jnp.int32)  # widen on-device, untimed
+        jax.block_until_ready(b)
+        log_stage(f"transfer done; compiling+warming fit on "
+                  f"{device.platform}")
         _, margin = fit(b, yy, w)
         jax.block_until_ready(margin)  # compile + warm
+        log_stage("warm fit done; timing")
         start = time.perf_counter()
         _, margin = fit(b, yy, w)
         jax.block_until_ready(margin)
         elapsed = time.perf_counter() - start
+    log_stage(f"timed fit done: {elapsed:.3f}s")
     acc = float(((np.asarray(margin) > 0) == np.asarray(y)).mean())
     return len(y) * rounds / elapsed, elapsed, acc
 
@@ -161,18 +188,28 @@ def run_bench(force_cpu):
 
     with tempfile.TemporaryDirectory() as tmpdir:
         pipeline_smoke(tmpdir)
+    log_stage("pipeline smoke done")
 
     x, y = make_higgs_like(N_ROWS, N_FEATURES)
     param = GBDTParam(num_boost_round=TPU_ROUNDS, max_depth=MAX_DEPTH,
                       num_bins=NUM_BINS, learning_rate=0.3)
     model = GBDT(param, num_feature=N_FEATURES)
     model.make_bins(x[:50_000])
+    log_stage(f"data + quantile boundaries ready ({N_ROWS} rows)")
 
     accel = jax.devices()[0]
     platform = accel.platform
     on_accel = platform != "cpu"
-    with jax.default_device(accel):
-        bins = np.asarray(apply_bins(x, model.boundaries)).astype(np.int32)
+    # Binning is untimed setup: run it on the HOST backend and ship only
+    # the compact uint8 bins to the accelerator.  Binning on the
+    # accelerator costs x (f32) up + bins (i32) back + bins up again —
+    # ~3x the bytes through the axon tunnel, whose host<->device
+    # bandwidth, not the chip, dominated the r5 2M-row attempt.
+    cpu0 = jax.devices("cpu")[0]
+    with jax.default_device(cpu0):
+        bins = np.asarray(apply_bins(x, model.boundaries))
+    bins = bins.astype(np.uint8 if NUM_BINS <= 256 else np.int32)
+    log_stage(f"host-side binning done ({bins.dtype}, {bins.nbytes/1e6:.0f} MB)")
 
     accel_method = resolve_hist_method("auto")
     accel_rounds = TPU_ROUNDS if on_accel else CPU_ROUNDS
@@ -182,8 +219,7 @@ def run_bench(force_cpu):
     # single-host CPU baseline on the identical workload (scatter is the
     # fastest CPU hist formulation; the pallas kernel is the fastest TPU one)
     if on_accel:
-        cpu = jax.devices("cpu")[0]
-        cpu_rps, cpu_s, _ = time_fit(model, bins, y, CPU_ROUNDS, cpu,
+        cpu_rps, cpu_s, _ = time_fit(model, bins, y, CPU_ROUNDS, cpu0,
                                      "scatter")
     else:
         cpu_rps = accel_rps  # vs_baseline := 1.0 — no accelerator this run
@@ -254,11 +290,20 @@ def attempt(mode, timeout_s):
             capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(SCRIPT_PATH) or ".",
         )
-    except subprocess.TimeoutExpired:
-        print(f"bench child {mode} timed out after {timeout_s}s",
-              file=sys.stderr)
+    except subprocess.TimeoutExpired as e:
+        # Surface the child's stage trail (log_stage markers) so the
+        # timeout says WHERE the budget went, not just that it ran out.
+        trail = ""
+        for s in (e.stderr, e.output):
+            if s:
+                trail += s if isinstance(s, str) else s.decode(
+                    "utf-8", errors="replace")
+        trail = trail[-1500:]
+        print(f"bench child {mode} timed out after {timeout_s}s; "
+              f"child trail:\n{trail}", file=sys.stderr)
         persist_stage(_stage_name(mode),
-                      {"error": f"timeout after {timeout_s}s"})
+                      {"error": f"timeout after {timeout_s}s",
+                       "child_trail": trail})
         return None
     for line in proc.stdout.splitlines():
         if line.startswith(JSON_TAG):

@@ -57,8 +57,13 @@ run_step 05_eval_fit 900 python benchmarks/snippets/eval_fit.py
 run_step 06_levers 1800 python benchmarks/bench_levers.py 2000000
 
 # 7. scaled driver-metric capture: rows/sec at 2M rows must land within
-#    ~20% of the 200k figure (headline not a small-working-set artifact)
-BENCH_ROWS=2000000 run_step 07_bench_2m 1800 python bench.py
+#    ~20% of the 200k figure (headline not a small-working-set artifact).
+#    Child budget raised above the 900s default: the tunnel's host->device
+#    bandwidth makes the (untimed) 2M setup slow even after the uint8
+#    transfer diet; the stage trail in the log shows the split.  Outer
+#    budget must cover probe + TPU child + CPU-fallback child (the
+#    always-emit-JSON contract dies with the parent otherwise).
+BENCH_ROWS=2000000 BENCH_ATTEMPT_TIMEOUT_S=1500 run_step 07_bench_2m 3600 python bench.py
 
 # 8. cached + remote fast-path numbers on this host
 run_step 08_cached 900 python benchmarks/bench_cached.py 256 --remote
