@@ -119,6 +119,43 @@ def log_stage(msg):
 _T0 = time.perf_counter()
 
 
+class SoftDeadline(Exception):
+    """Raised between child stages when the wall-clock budget is nearly
+    gone: the child then exits CLEANLY (honest error JSON, rc 0) instead
+    of being SIGKILLed mid-device-op by the parent — hard kills of a
+    client mid-computation are what wedge the axon tunnel (observed r3
+    and again r5, BASELINE.md)."""
+
+
+def check_deadline(where):
+    limit = float(os.environ.get("BENCH_CHILD_DEADLINE_S", 0) or 0)
+    if limit and time.perf_counter() - _T0 > limit:
+        raise SoftDeadline(
+            f"soft deadline {limit:.0f}s exceeded at '{where}' "
+            f"(+{time.perf_counter() - _T0:.1f}s)")
+
+
+def chunked_device_put(arr, device, n_chunks=16):
+    """device_put in row slices with deadline checks between slices: a
+    slow tunnel transfer then fails between small ops (clean exit)
+    instead of inside one giant RPC the parent can only SIGKILL."""
+    import jax
+    import jax.numpy as jnp
+
+    if len(arr) < n_chunks * 2:
+        return jax.device_put(arr, device)
+    bounds = [len(arr) * i // n_chunks for i in range(n_chunks + 1)]
+    parts = []
+    for i in range(n_chunks):
+        parts.append(jax.device_put(arr[bounds[i]:bounds[i + 1]], device))
+        jax.block_until_ready(parts[-1])
+        check_deadline(f"transfer chunk {i + 1}/{n_chunks}")
+    with jax.default_device(device):
+        out = jnp.concatenate(parts, axis=0)
+    jax.block_until_ready(out)
+    return out
+
+
 def time_fit(model, bins, y, rounds, device, method):
     """Time fit with each backend's best hist algorithm.
 
@@ -133,7 +170,7 @@ def time_fit(model, bins, y, rounds, device, method):
     fit = model._fit_fn(rounds, method)
     log_stage(f"transfer to {device.platform}: bins "
               f"{bins.nbytes / 1e6:.0f} MB ({bins.dtype}) + labels")
-    b = jax.device_put(bins, device)
+    b = chunked_device_put(bins, device)
     yy = jax.device_put(y, device)
     w = jax.device_put(np.ones(len(y), np.float32), device)
     with jax.default_device(device):
@@ -142,9 +179,11 @@ def time_fit(model, bins, y, rounds, device, method):
         jax.block_until_ready(b)
         log_stage(f"transfer done; compiling+warming fit on "
                   f"{device.platform}")
+        check_deadline("before compile")
         _, margin = fit(b, yy, w)
         jax.block_until_ready(margin)  # compile + warm
         log_stage("warm fit done; timing")
+        check_deadline("before timed fit")
         start = time.perf_counter()
         _, margin = fit(b, yy, w)
         jax.block_until_ready(margin)
@@ -215,12 +254,34 @@ def run_bench(force_cpu):
     accel_rounds = TPU_ROUNDS if on_accel else CPU_ROUNDS
     accel_rps, accel_s, acc = time_fit(model, bins, y, accel_rounds, accel,
                                        accel_method)
+    mode = "--child-cpu" if force_cpu else "--child"
+    # The accelerator number is the measurement of record: persist it the
+    # moment it exists, so a soft-deadline abort in the baseline phase
+    # below can't discard an already-completed (expensive) measurement.
+    persist_stage(_stage_name(mode) + "_accel_only",
+                  {"platform": platform, "accel_rows_per_sec":
+                   round(accel_rps, 1), "seconds": round(accel_s, 3)})
 
-    # single-host CPU baseline on the identical workload (scatter is the
-    # fastest CPU hist formulation; the pallas kernel is the fastest TPU one)
+    # single-host CPU baseline on the identical workload shape (scatter is
+    # the fastest CPU hist formulation; the pallas kernel is the fastest
+    # TPU one).  Rows are capped at 200k: CPU rows/sec is size-normalized
+    # and tunnel-free, and an uncapped 2M baseline fit is exactly the kind
+    # of budget sink that aborts a child after the real measurement
+    # succeeded (detail carries the cap when it binds).
+    baseline_cap = min(N_ROWS, 200_000)
+    cpu_baseline_note = None
     if on_accel:
-        cpu_rps, cpu_s, _ = time_fit(model, bins, y, CPU_ROUNDS, cpu0,
-                                     "scatter")
+        try:
+            cpu_rps, cpu_s, _ = time_fit(model, bins[:baseline_cap],
+                                         y[:baseline_cap], CPU_ROUNDS, cpu0,
+                                         "scatter")
+            if baseline_cap < N_ROWS:
+                cpu_baseline_note = f"baseline on {baseline_cap} rows"
+        except SoftDeadline as e:
+            log_stage(f"CPU baseline aborted ({e}); emitting accel result "
+                      f"with vs_baseline=0.0")
+            cpu_rps = None
+            cpu_baseline_note = f"baseline aborted: {e}"
     else:
         cpu_rps = accel_rps  # vs_baseline := 1.0 — no accelerator this run
 
@@ -264,7 +325,7 @@ def run_bench(force_cpu):
         "value": round(accel_rps, 1),
         "unit": (f"rows/sec ({N_ROWS} rows x {N_FEATURES} feat, "
                  f"depth-{MAX_DEPTH}, {NUM_BINS}-bin hist)"),
-        "vs_baseline": round(accel_rps / cpu_rps, 3),
+        "vs_baseline": round(accel_rps / cpu_rps, 3) if cpu_rps else 0.0,
         "platform": platform,
         "tpu_available": on_accel,
         "detail": {
@@ -273,47 +334,73 @@ def run_bench(force_cpu):
             "hist_i8_compares": _i8_state(),
             "rounds": accel_rounds,
             "seconds": round(accel_s, 3),
-            "cpu_rows_per_sec": round(cpu_rps, 1),
+            "cpu_rows_per_sec": round(cpu_rps, 1) if cpu_rps else None,
             "train_acc": round(acc, 4),
         },
     }
+    if cpu_baseline_note:
+        result["detail"]["cpu_baseline_note"] = cpu_baseline_note
     if roofline is not None:
         result["detail"]["roofline"] = roofline
     print(JSON_TAG + json.dumps(result), flush=True)
 
 
 def attempt(mode, timeout_s):
-    """Run a child stage once; return parsed JSON dict or None."""
+    """Run a child stage once; return parsed JSON dict or None.
+
+    The child is given a soft deadline (~45s inside our hard budget) so a
+    slow run exits CLEANLY with an error JSON before we have to kill it;
+    on hard timeout we SIGTERM first and SIGKILL only as a last resort —
+    a client hard-killed mid-RPC is what wedges the axon tunnel.
+    """
+    child_env = dict(os.environ,
+                     BENCH_CHILD_DEADLINE_S=str(max(timeout_s - 45, 30)))
+    proc = subprocess.Popen(
+        [sys.executable, SCRIPT_PATH, mode],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(SCRIPT_PATH) or ".", env=child_env)
+    timed_out = False
     try:
-        proc = subprocess.run(
-            [sys.executable, SCRIPT_PATH, mode],
-            capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(SCRIPT_PATH) or ".",
-        )
-    except subprocess.TimeoutExpired as e:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.terminate()
+        try:
+            out, err = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+    except BaseException:
+        # subprocess.run kills the child on ANY exception (incl. Ctrl-C);
+        # keep that guarantee or an interrupted parent leaks a child
+        # holding the tunnel client alive.
+        proc.kill()
+        proc.wait()
+        raise
+    if timed_out:
         # Surface the child's stage trail (log_stage markers) so the
         # timeout says WHERE the budget went, not just that it ran out.
-        trail = ""
-        for s in (e.stderr, e.output):
-            if s:
-                trail += s if isinstance(s, str) else s.decode(
-                    "utf-8", errors="replace")
-        trail = trail[-1500:]
+        trail = ((err or "") + (out or ""))[-1500:]
         print(f"bench child {mode} timed out after {timeout_s}s; "
               f"child trail:\n{trail}", file=sys.stderr)
         persist_stage(_stage_name(mode),
                       {"error": f"timeout after {timeout_s}s",
                        "child_trail": trail})
         return None
-    for line in proc.stdout.splitlines():
+    for line in (out or "").splitlines():
         if line.startswith(JSON_TAG):
             try:
                 parsed = json.loads(line[len(JSON_TAG):])
-                persist_stage(_stage_name(mode), parsed)
-                return parsed
             except json.JSONDecodeError:
-                pass
-    tail = (proc.stderr or "")[-2000:]
+                continue
+            persist_stage(_stage_name(mode), parsed)
+            if "error" in parsed:
+                # clean soft-deadline abort: failed attempt, no kill needed
+                print(f"bench child {mode} aborted cleanly: "
+                      f"{parsed['error']}", file=sys.stderr)
+                return None
+            return parsed
+    tail = (err or "")[-2000:]
     print(f"bench child {mode} failed rc={proc.returncode}:\n{tail}",
           file=sys.stderr)
     persist_stage(_stage_name(mode),
@@ -355,11 +442,24 @@ def main():
 
 
 if __name__ == "__main__":
+    if "--probe" in sys.argv or "--child" in sys.argv \
+            or "--child-cpu" in sys.argv:
+        # SIGTERM -> SystemExit: the parent's graceful-stop escalation
+        # only helps if the interpreter unwinds (JAX client teardown)
+        # rather than dying handler-less mid-RPC.
+        import signal
+
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     if "--probe" in sys.argv:
         run_probe()
-    elif "--child" in sys.argv:
-        run_bench(force_cpu=False)
-    elif "--child-cpu" in sys.argv:
-        run_bench(force_cpu=True)
+    elif "--child" in sys.argv or "--child-cpu" in sys.argv:
+        try:
+            run_bench(force_cpu="--child-cpu" in sys.argv)
+        except SoftDeadline as e:
+            # Clean, honest exit: the parent sees the tagged error JSON,
+            # treats the attempt as failed, and no mid-RPC SIGKILL ever
+            # reaches the tunnel client.
+            log_stage(str(e))
+            print(JSON_TAG + json.dumps({"error": str(e)}), flush=True)
     else:
         main()
