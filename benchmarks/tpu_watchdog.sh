@@ -16,7 +16,7 @@ PROBE_TIMEOUT_S=${PROBE_TIMEOUT_S:-180}
 
 while true; do
     ts=$(date -u +%FT%TZ)
-    if timeout "$PROBE_TIMEOUT_S" python - > "$RESULTS/watchdog_probe.log" 2>&1 <<'EOF'
+    timeout "$PROBE_TIMEOUT_S" python - > "$RESULTS/watchdog_probe.log" 2>&1 <<'EOF'
 import jax
 d = jax.devices()[0]
 assert d.platform == "tpu", d.platform
@@ -24,13 +24,14 @@ import jax.numpy as jnp
 jnp.ones((8, 8)).block_until_ready()   # a half-alive tunnel fails here
 print("tpu alive:", d)
 EOF
-    then
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
         echo "$ts TPU ALIVE - running on-chip checklist"
         echo "$ts" > "$RESULTS/tpu_alive_at.txt"
         bash benchmarks/on_chip_checklist.sh
         echo "$(date -u +%FT%TZ) checklist finished"
         exit 0
     fi
-    echo "$ts tunnel still wedged (probe rc=$?)"
+    echo "$ts tunnel still wedged (probe rc=$rc; 124=hung)"
     sleep "$PROBE_INTERVAL_S"
 done
