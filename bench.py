@@ -353,8 +353,16 @@ def attempt(mode, timeout_s):
     on hard timeout we SIGTERM first and SIGKILL only as a last resort —
     a client hard-killed mid-RPC is what wedges the axon tunnel.
     """
-    child_env = dict(os.environ,
-                     BENCH_CHILD_DEADLINE_S=str(max(timeout_s - 45, 30)))
+    # ~45s inside our hard budget, unless the operator pinned it explicitly;
+    # a pin is validated and clamped below the hard budget so it can never
+    # re-enable the mid-RPC SIGKILL this mechanism exists to avoid
+    auto_deadline = max(timeout_s - 45, 30)
+    try:
+        pinned = float(os.environ.get("BENCH_CHILD_DEADLINE_S", ""))
+        deadline = min(pinned, auto_deadline)
+    except ValueError:
+        deadline = auto_deadline
+    child_env = dict(os.environ, BENCH_CHILD_DEADLINE_S=str(deadline))
     proc = subprocess.Popen(
         [sys.executable, SCRIPT_PATH, mode],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,

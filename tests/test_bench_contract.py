@@ -33,6 +33,7 @@ def bench_run(tmp_path_factory):
         "BENCH_STAGE_DIR": str(stage_dir),
     })
     env.pop("XLA_FLAGS", None)
+    env.pop("BENCH_CHILD_DEADLINE_S", None)  # ambient pin would abort all
     proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
                           capture_output=True, text=True, timeout=600)
     return proc, stage_dir
@@ -57,11 +58,52 @@ def test_stage_files_persist_as_stages_complete(bench_run):
     # at minimum the successful child stage must have its own file, keyed
     # by workload size so a later BENCH_ROWS=2M run can never clobber it
     assert any("rows2000" in s for s in stages), stages
-    child = [p for p in stage_dir.iterdir() if "child" in p.name]
+    child = [p for p in stage_dir.iterdir()
+             if "child" in p.name and "accel_only" not in p.name]
     assert child, stages
     payload = json.loads(child[0].read_text())
     assert payload["stage"].endswith("rows2000")
     assert "time" in payload
+    # the accelerator number is additionally persisted the moment it
+    # exists, before the CPU-baseline phase can spend (or abort) anything
+    accel_only = [p for p in stage_dir.iterdir() if "accel_only" in p.name]
+    assert accel_only, stages
+    partial = json.loads(accel_only[0].read_text())
+    assert partial["accel_rows_per_sec"] > 0
+
+
+def test_soft_deadline_aborts_cleanly_and_still_emits_json(tmp_path):
+    """Wedge-avoidance contract (r5): an over-budget child must exit
+    CLEANLY with a tagged error (never be SIGKILLed mid-device-op — hard
+    kills are what wedge the axon tunnel), and even when EVERY attempt
+    aborts, the driver still gets exactly one valid JSON line, rc 0."""
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_ROWS": "2000",
+        "BENCH_TPU_ROUNDS": "1",
+        "BENCH_CPU_ROUNDS": "1",
+        "BENCH_PROBE_TIMEOUT_S": "3",
+        "BENCH_STAGE_DIR": str(tmp_path),
+        # operator-pinned deadline far below any real run: every child
+        # aborts at its first between-stage check
+        "BENCH_CHILD_DEADLINE_S": "0.01",
+    })
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, lines
+    result = json.loads(lines[0])
+    assert result["metric"] == "gbdt_hist_train_rows_per_sec_per_chip"
+    # all attempts soft-aborted -> honest all-failed fallback, not a crash
+    assert result["platform"] == "none"
+    assert "aborted cleanly" in proc.stderr or "soft deadline" in proc.stderr
+    # the abort is persisted as evidence, tagged with where it fired
+    aborted = [p for p in tmp_path.iterdir() if "child" in p.name]
+    assert any("soft deadline" in json.loads(p.read_text()).get("error", "")
+               for p in aborted), [p.name for p in aborted]
 
 
 def test_roofline_absent_off_tpu(bench_run):
