@@ -25,6 +25,9 @@ def counterfactual_gate(rows):
     timings are meaningless there and reps drop to 1."""
     import jax
 
+    from dmlc_core_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()  # JAX_PLATFORMS=cpu works under sitecustomize
     if jax.devices()[0].platform == "tpu":
         return rows, 3
     os.environ.setdefault("DMLC_TPU_PALLAS_INTERPRET", "1")

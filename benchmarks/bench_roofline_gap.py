@@ -21,10 +21,16 @@ benchmarks/results/09_roofline.log.  Runs on whatever backend jax gives
 us but labels non-TPU runs as counterfactual.
 """
 import os
+import sys
 import time
 
 import numpy as np
 import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from dmlc_core_tpu.utils.platform import sync_platform_from_env  # noqa: E402
+
+sync_platform_from_env()  # JAX_PLATFORMS=cpu works under sitecustomize
 
 # one platform probe serves the interpret gate, the sizing constants, and
 # the printed label; off-chip (counterfactual) runs must interpret the

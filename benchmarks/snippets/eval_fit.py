@@ -10,6 +10,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
 from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+from dmlc_core_tpu.utils.platform import sync_platform_from_env
+
+sync_platform_from_env()  # JAX_PLATFORMS=cpu works under sitecustomize
 
 rng = np.random.RandomState(0)
 x = rng.randn(200_000, 28).astype(np.float32)
@@ -17,8 +20,16 @@ y = (x @ rng.randn(28) > 0).astype(np.float32)
 m = GBDT(GBDTParam(num_boost_round=10, max_depth=6, num_bins=256),
          num_feature=28)
 m.make_bins(x[:50_000])
-bins = np.asarray(m.bin_features(x), np.int32)
-tr, ev, ytr, yev = bins[:160_000], bins[160_000:], y[:160_000], y[160_000:]
+bins_np = np.asarray(m.bin_features(x), np.uint8)
+# device-resident inputs so both A/B arms time fit work, not the ~20 MB
+# tunnel transfer a numpy array would re-pay inside each timed call
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+tr = jnp.asarray(jax.device_put(bins_np[:160_000]), jnp.int32)
+ev = jnp.asarray(jax.device_put(bins_np[160_000:]), jnp.int32)
+ytr, yev = jax.device_put(y[:160_000]), jax.device_put(y[160_000:])
+jax.block_until_ready((tr, ev, ytr, yev))
 for mode in (True, False):
     m.fit_with_eval(tr, ytr, ev, yev, compiled=mode)
     t0 = time.perf_counter()

@@ -12,6 +12,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
 from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+from dmlc_core_tpu.utils.platform import sync_platform_from_env
+
+sync_platform_from_env()  # JAX_PLATFORMS=cpu works under sitecustomize
 
 rows, F = 200_000, 28
 rng = np.random.RandomState(0)
@@ -21,7 +24,14 @@ x[rng.rand(rows, F) < 0.2] = np.nan
 m = GBDT(GBDTParam(num_boost_round=10, max_depth=6, num_bins=256,
                    handle_missing=True), num_feature=F)
 m.make_bins(x[:50_000])
-bins = np.asarray(m.bin_features(x), np.int32)
+# device-resident inputs: a numpy `bins` would re-ship ~22 MB through the
+# tunnel inside every timed rep (the r5 bench_levers lesson)
+import jax.numpy as jnp  # noqa: E402
+
+bins = jnp.asarray(jax.device_put(
+    np.asarray(m.bin_features(x), np.uint8)), jnp.int32)
+y = jax.device_put(y)
+jax.block_until_ready((bins, y))
 ens, margin = m.fit_binned(bins, y)          # warm compile
 jax.block_until_ready(margin)
 best = 1e9
