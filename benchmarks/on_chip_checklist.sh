@@ -33,6 +33,10 @@ run_step() {  # run_step <name> <timeout_s> <cmd...>
 run_step 00_probe 120 python -c "import jax; print(jax.devices())" || {
     echo "TUNNEL WEDGED/ABSENT - stop here"; exit 1; }
 
+# 0b. tunnel host<->device bandwidth at 1/16/64 MB — the rate every later
+#     stage-trail should be read against
+run_step 00b_tunnel_bw 300 python benchmarks/snippets/tunnel_bw.py
+
 # 1. real-Mosaic kernel lane: lowering + numerics of plain/fused/blocked
 #    kernels, the int8 probe, and a tiny end-to-end fit
 DMLC_TPU_LIVE=1 run_step 01_livetests 1200 python -m pytest livetests/ -q -rs
@@ -71,6 +75,12 @@ run_step 08_cached 900 python benchmarks/bench_cached.py 256 --remote
 # 9. roofline-gap profile (r4 VERDICT item 7): per-kernel timing of the
 #    pallas hist at bench shapes vs the lane-op bound
 run_step 09_roofline 900 python benchmarks/bench_roofline_gap.py
+
+# 10. the north star at its literal scale: HIGGS-shaped 11M rows.  uint8
+#     bins are ~308 MB on the wire and ~1.2 GB widened in HBM; budget
+#     sized from the step-0b bandwidth (at 10 MB/s the transfer alone is
+#     ~30s; generation+binning on this 1-core host adds minutes).
+BENCH_ROWS=11000000 BENCH_ATTEMPT_TIMEOUT_S=2100 run_step 10_bench_11m 4800 python bench.py
 
 echo "=== checklist complete; results in $RESULTS/"
 ls -la "$RESULTS"

@@ -1,0 +1,51 @@
+"""Axon tunnel host<->device bandwidth probe (checklist step 0b).
+
+The r5 window showed every host-side number is shaped by the tunnel's
+transfer rate (lever sweeps re-shipping bins measured ~10-15 MB/s, and
+the 2M bench child burned its budget before the timed region).  This
+probe pins the number down directly: device_put (up) and np.asarray
+(down) at three sizes, so later stages' stage-trails can be read against
+a measured rate instead of a guess.  Runs in ~a minute; prints one line
+per (direction, size).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from dmlc_core_tpu.utils.platform import sync_platform_from_env
+
+sync_platform_from_env()  # JAX_PLATFORMS=cpu works under sitecustomize
+
+import jax  # noqa: E402
+
+dev = jax.devices()[0]
+print(f"device: {dev} (platform={dev.platform})")
+
+# throwaway transfer: the first device_put through the tunneled PJRT
+# client pays one-time path/handshake cost that must not land in a rate
+warm = jax.device_put(np.zeros(1024, np.uint8), dev)
+jax.block_until_ready(warm)
+np.asarray(warm)
+
+REPS = 3  # best-of-N: single draws on this link are bimodal
+for mb in (1, 16, 64):
+    arr = np.random.RandomState(0).randint(
+        0, 255, (mb * 1024 * 1024,), dtype=np.uint8)
+    up_s, down_s = 1e18, 1e18
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        d = jax.device_put(arr, dev)
+        jax.block_until_ready(d)
+        up_s = min(up_s, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        back = np.asarray(d)
+        down_s = min(down_s, time.perf_counter() - t0)
+        assert back[0] == arr[0] and back[-1] == arr[-1]
+    print(f"{mb:3d} MB  up {mb / up_s:8.1f} MB/s ({up_s * 1e3:7.1f} ms)   "
+          f"down {mb / down_s:8.1f} MB/s ({down_s * 1e3:7.1f} ms)  "
+          f"best-of-{REPS}")
