@@ -38,7 +38,7 @@ if [ ! -f "$DATA" ]; then
     mv "$DATA.tmp" "$DATA"
 fi
 
-# 3. interleaved single-threaded runs
+# 3. interleaved single-threaded runs (the reference's own harness)
 echo "== interleaved A/B, nthread=1, $REPS reps each"
 for i in $(seq "$REPS"); do
     echo "-- rep $i"
@@ -47,4 +47,31 @@ for i in $(seq "$REPS"); do
     echo "reference: $ref_line"
     python benchmarks/bench_pipeline.py parser "$DATA" libsvm 1 2>/dev/null \
         | tail -1 | sed 's/^/ours:      /'
+done
+
+# 4. all three text formats through the FAIR driver (the reference's own
+#    csv harness times an untimed warm-up pass into its rate and its libfm
+#    harness prints per batch inside the timed loop — ref_parser_bench.cc
+#    gives the reference library the same clean protocol ours uses)
+if [ ! -x "$WORK/ref_parser_bench" ]; then
+    g++ -O3 -march=native -std=c++17 -I"$REF/include" -I"$REF" \
+        benchmarks/ref_parser_bench.cc "$WORK/refbuild/libdmlc.a" \
+        -o "$WORK/ref_parser_bench" -lpthread -fopenmp
+fi
+for FMT in libsvm libfm csv; do
+    FDATA="$WORK/higgs_${ROWS}.$FMT"
+    if [ ! -f "$FDATA" ]; then
+        python benchmarks/bench_pipeline.py gen "$FDATA.tmp" "$ROWS" 28 "$FMT"
+        mv "$FDATA.tmp" "$FDATA"
+    fi
+    OURS_URI="$FDATA"
+    [ "$FMT" = csv ] && OURS_URI="$FDATA?label_column=0"
+    echo "== $FMT, fair driver, interleaved, nthread=1"
+    for i in $(seq "$REPS"); do
+        ref_line=$("$WORK/ref_parser_bench" "$FDATA" "$FMT" 1 2>/dev/null | tail -1)
+        [ -n "$ref_line" ] || { echo "fair driver produced no output for $FMT" >&2; exit 1; }
+        echo "reference: $ref_line"
+        python benchmarks/bench_pipeline.py parser "$OURS_URI" "$FMT" 1 2>/dev/null \
+            | tail -1 | sed 's/^/ours:      /'
+    done
 done

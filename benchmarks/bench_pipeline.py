@@ -4,7 +4,7 @@ split_read_test.cc, libsvm_parser_test.cc — they print MB/sec).
 
     python benchmarks/bench_pipeline.py split  <uri> [part] [nparts] [type]
     python benchmarks/bench_pipeline.py parser <uri> [format] [nthread]
-    python benchmarks/bench_pipeline.py gen    <path> [rows] [features]
+    python benchmarks/bench_pipeline.py gen    <path> [rows] [features] [libsvm|libfm|csv]
     python benchmarks/bench_pipeline.py genrec <path.rec> [records] [bytes]
     python benchmarks/bench_pipeline.py infeed <path.rec> [record_bytes] [batch]
 """
@@ -46,8 +46,13 @@ def bench_parser(uri, fmt="auto", nthread=2):
     print(f"{rows} rows; {meter.summary()}")
 
 
-def gen(path, rows=1_000_000, features=28):
-    """Synthetic HIGGS-like libsvm file for benchmarking."""
+def gen(path, rows=1_000_000, features=28, fmt="libsvm"):
+    """Synthetic HIGGS-like text file for benchmarking.
+
+    ``fmt``: ``libsvm`` (``label j:v ...``), ``libfm`` (``label j:j:v ...``
+    field==index triples) or ``csv`` (``label,v,...``) — the same data in
+    each syntax so parser A/Bs compare like against like.
+    """
     import numpy as np
 
     rows, features = int(rows), int(features)
@@ -59,10 +64,19 @@ def gen(path, rows=1_000_000, features=28):
             y = rng.randint(0, 2, n)
             lines = []
             for i in range(n):
-                feats = " ".join(f"{j}:{x[i, j]:.4f}" for j in range(features))
-                lines.append(f"{y[i]} {feats}")
+                if fmt == "csv":
+                    row = ",".join(f"{x[i, j]:.4f}" for j in range(features))
+                    lines.append(f"{y[i]},{row}")
+                elif fmt == "libfm":
+                    feats = " ".join(f"{j}:{j}:{x[i, j]:.4f}"
+                                     for j in range(features))
+                    lines.append(f"{y[i]} {feats}")
+                else:
+                    feats = " ".join(f"{j}:{x[i, j]:.4f}"
+                                     for j in range(features))
+                    lines.append(f"{y[i]} {feats}")
             f.write("\n".join(lines) + "\n")
-    print(f"wrote {rows} rows to {path} "
+    print(f"wrote {rows} {fmt} rows to {path} "
           f"({os.path.getsize(path) / (1 << 20):.1f} MB)")
 
 

@@ -1,0 +1,65 @@
+// Fair single-pass timing driver over the REFERENCE library's parsers
+// (csv / libfm / libsvm), for the head-to-head in BASELINE.md.
+//
+// Why not the reference's own csv/libfm harnesses
+// (/root/reference/test/csv_parser_test.cc:28-33 starts its timer before
+// an untimed full warm-up pass, so its MB/sec charges two passes of work
+// to one pass of bytes; libfm_parser_test.cc:26 prints a line per batch
+// inside the timed loop): beating those numbers would measure their
+// harness artifacts, not their parser.  This driver gives the reference
+// the SAME clean protocol our side uses — construct, parse once, time
+// it, print at the end — built out-of-tree against an unmodified
+// /root/reference checkout.
+//
+//   ref_parser_bench <file> <libsvm|libfm|csv> [nthread=1] [label_column=0]
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+
+#include <dmlc/data.h>
+#include <dmlc/io.h>
+#include <dmlc/timer.h>
+#include "src/data/csv_parser.h"
+#include "src/data/libfm_parser.h"
+#include "src/data/libsvm_parser.h"
+
+template <typename ParserT>
+static void run(ParserT* parser) {
+  double t0 = dmlc::GetTime();
+  size_t rows = 0;
+  while (parser->Next()) rows += parser->Value().size;
+  double dt = dmlc::GetTime() - t0;
+  double mb = parser->BytesRead() / (1024.0 * 1024.0);
+  std::printf("%zu rows, %.1f MB, %.1f MB/sec\n", rows, mb, mb / dt);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::printf("Usage: %s <file> <libsvm|libfm|csv> [nthread] [label_col]\n",
+                argv[0]);
+    return 2;
+  }
+  const char* path = argv[1];
+  const std::string fmt = argv[2];
+  const int nthread = argc > 3 ? std::atoi(argv[3]) : 1;
+  dmlc::InputSplit* split = dmlc::InputSplit::Create(path, 0, 1, "text");
+  if (fmt == "libsvm") {
+    dmlc::data::LibSVMParser<unsigned> p(split, nthread);
+    run(&p);
+  } else if (fmt == "libfm") {
+    dmlc::data::LibFMParser<unsigned> p(split, nthread);
+    run(&p);
+  } else if (fmt == "csv") {
+    std::map<std::string, std::string> args;
+    args["label_column"] = argc > 4 ? argv[4] : "0";
+    dmlc::data::CSVParser<unsigned> p(split, args, nthread);
+    run(&p);
+  } else {
+    std::fprintf(stderr, "unknown format %s\n", fmt.c_str());
+    return 2;
+  }
+  return 0;
+}
