@@ -18,6 +18,11 @@ RESULTS=benchmarks/results
 mkdir -p "$RESULTS"
 export BENCH_STAGE_DIR="$RESULTS"
 
+# this run's evidence starts clean: stale step logs / attempt JSONs from a
+# previous run must not masquerade as this run's (watchdog logs are kept)
+rm -f "$RESULTS"/[0-9]*_*.log "$RESULTS"/attempt_*.json
+
+FAILS=0
 run_step() {  # run_step <name> <timeout_s> <cmd...>
     local name=$1 tmo=$2; shift 2
     echo "=== [$name] $* (timeout ${tmo}s)"
@@ -26,12 +31,22 @@ run_step() {  # run_step <name> <timeout_s> <cmd...>
     echo "rc=$rc" >> "$RESULTS/$name.log"
     echo "=== [$name] rc=$rc ($( [ $rc -eq 124 ] && echo TIMED-OUT || echo done ))"
     tail -4 "$RESULTS/$name.log"
+    [ $rc -eq 0 ] || FAILS=$((FAILS + 1))
     return $rc
+}
+
+finish() {  # archive THIS run's files and exit with the failed-step count
+    echo "=== checklist done; $FAILS step(s) failed; results in $RESULTS/"
+    local archive="$RESULTS/run_$(date -u +%Y%m%dT%H%M%SZ)"
+    mkdir -p "$archive"
+    cp "$RESULTS"/[0-9]*_*.log "$RESULTS"/attempt_*.json "$archive"/ 2>/dev/null || true
+    echo "archived to $archive"
+    exit $(( FAILS > 120 ? 120 : FAILS ))
 }
 
 # 0. is the chip actually reachable? (a wedged tunnel hangs jax.devices())
 run_step 00_probe 120 python -c "import jax; print(jax.devices())" || {
-    echo "TUNNEL WEDGED/ABSENT - stop here"; exit 1; }
+    echo "TUNNEL WEDGED/ABSENT - stop here"; finish; }
 
 # 0b. tunnel host<->device bandwidth at 1/16/64 MB — the rate every later
 #     stage-trail should be read against
@@ -82,5 +97,5 @@ run_step 09_roofline 900 python benchmarks/bench_roofline_gap.py
 #     ~30s; generation+binning on this 1-core host adds minutes).
 BENCH_ROWS=11000000 BENCH_ATTEMPT_TIMEOUT_S=2100 run_step 10_bench_11m 4800 python bench.py
 
-echo "=== checklist complete; results in $RESULTS/"
 ls -la "$RESULTS"
+finish

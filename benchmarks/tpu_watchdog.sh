@@ -13,6 +13,8 @@ RESULTS=benchmarks/results
 mkdir -p "$RESULTS"
 PROBE_INTERVAL_S=${PROBE_INTERVAL_S:-600}
 PROBE_TIMEOUT_S=${PROBE_TIMEOUT_S:-180}
+MAX_RUNS=${MAX_RUNS:-5}   # stand down after this many non-clean checklists
+runs=0
 
 while true; do
     ts=$(date -u +%FT%TZ)
@@ -29,9 +31,17 @@ EOF
         echo "$ts TPU ALIVE - running on-chip checklist"
         echo "$ts" > "$RESULTS/tpu_alive_at.txt"
         bash benchmarks/on_chip_checklist.sh
-        echo "$(date -u +%FT%TZ) checklist finished"
-        exit 0
+        ck=$?
+        runs=$((runs + 1))
+        echo "$(date -u +%FT%TZ) checklist finished ($ck step(s) failed; run $runs/$MAX_RUNS)"
+        # stand down after an all-pass run; a half-alive tunnel that failed
+        # some steps gets another attempt at the next alive window, but a
+        # deterministic failure can't re-burn the chip forever
+        [ "$ck" -eq 0 ] && exit 0
+        [ "$runs" -ge "$MAX_RUNS" ] && {
+            echo "$(date -u +%FT%TZ) giving up after $runs non-clean runs"; exit 1; }
+    else
+        echo "$ts tunnel still wedged (probe rc=$rc; 124=hung)"
     fi
-    echo "$ts tunnel still wedged (probe rc=$rc; 124=hung)"
     sleep "$PROBE_INTERVAL_S"
 done
