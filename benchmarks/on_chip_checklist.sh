@@ -48,17 +48,33 @@ finish() {  # archive THIS run's files and exit with the failed-step count
 run_step 00_probe 120 python -c "import jax; print(jax.devices())" || {
     echo "TUNNEL WEDGED/ABSENT - stop here"; finish; }
 
+# Ordering: highest-value evidence first — a tunnel window can close at
+# any moment, so the headline bench must land in the first minutes, not
+# after a 20-minute livetest lane (the r5 first window spent 4 minutes on
+# livetests before the flagship number).
+
 # 0b. tunnel host<->device bandwidth at 1/16/64 MB — the rate every later
 #     stage-trail should be read against
 run_step 00b_tunnel_bw 300 python benchmarks/snippets/tunnel_bw.py
 
+# 2. the flagship bench (driver metric): expect ~130-170 ms full fit
+#    (bimodal tunnel noise, see BASELINE.md), i.e. 12-15.5M rows/s
+run_step 02_bench_200k 1200 python bench.py
+
+# 7. scaled driver-metric capture: rows/sec at 2M rows must land within
+#    ~20% of the 200k figure (headline not a small-working-set artifact).
+#    Runs right after the 200k capture because it is the open r5 anomaly
+#    (the first window's 2M child burned its budget before producing).
+#    Child budget raised above the 900s default: the tunnel's host->device
+#    bandwidth makes the (untimed) 2M setup slow even after the uint8
+#    transfer diet; the stage trail in the log shows the split.  Outer
+#    budget must cover probe + TPU child + CPU-fallback child (the
+#    always-emit-JSON contract dies with the parent otherwise).
+BENCH_ROWS=2000000 BENCH_ATTEMPT_TIMEOUT_S=1500 run_step 07_bench_2m 3600 python bench.py
+
 # 1. real-Mosaic kernel lane: lowering + numerics of plain/fused/blocked
 #    kernels, the int8 probe, and a tiny end-to-end fit
 DMLC_TPU_LIVE=1 run_step 01_livetests 1200 python -m pytest livetests/ -q -rs
-
-# 2. the flagship bench (driver metric): expect ~130-170 ms full fit
-#    (bimodal tunnel noise, see BASELINE.md), i.e. 12-15.4M rows/s
-run_step 02_bench_200k 1200 python bench.py
 
 # 3. hist-method A/B (pallas vs fused vs onehot full fits)
 run_step 03_hist_variants 900 python benchmarks/bench_hist_variants.py
@@ -74,15 +90,6 @@ run_step 05_eval_fit 900 python benchmarks/snippets/eval_fit.py
 # 6. lever sweep: block_rows A/B, i8 probe, dead-row diagnostic, 2M-row
 #    scale
 run_step 06_levers 1800 python benchmarks/bench_levers.py 2000000
-
-# 7. scaled driver-metric capture: rows/sec at 2M rows must land within
-#    ~20% of the 200k figure (headline not a small-working-set artifact).
-#    Child budget raised above the 900s default: the tunnel's host->device
-#    bandwidth makes the (untimed) 2M setup slow even after the uint8
-#    transfer diet; the stage trail in the log shows the split.  Outer
-#    budget must cover probe + TPU child + CPU-fallback child (the
-#    always-emit-JSON contract dies with the parent otherwise).
-BENCH_ROWS=2000000 BENCH_ATTEMPT_TIMEOUT_S=1500 run_step 07_bench_2m 3600 python bench.py
 
 # 8. cached + remote fast-path numbers on this host
 run_step 08_cached 900 python benchmarks/bench_cached.py 256 --remote
