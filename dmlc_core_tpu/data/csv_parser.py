@@ -46,15 +46,19 @@ class CSVParser(TextParserBase):
 
         if not native_bridge.available():
             return None
-        dense = native_bridge.parse_csv(data, nthread=max(self._nthread, 2),
-                                        missing=self.param.missing)
-        return self._from_dense(dense)
+        parsed = native_bridge.parse_csv(data, nthread=max(self._nthread, 2),
+                                         missing=self.param.missing,
+                                         label_column=self.param.label_column)
+        if isinstance(parsed, tuple):
+            # native one-pass label split: no np.delete copy on this side
+            labels, feats = parsed
+            return self._assemble(labels, feats)
+        return self._from_dense(parsed)
 
     def _from_dense(self, dense: np.ndarray) -> RowBlockContainer:
-        out = RowBlockContainer(self._index_dtype)
         nrow, ncol = dense.shape
         if nrow == 0:
-            return out
+            return RowBlockContainer(self._index_dtype)
         lc = self.param.label_column
         if 0 <= lc < ncol:
             labels = dense[:, lc].copy()
@@ -62,11 +66,17 @@ class CSVParser(TextParserBase):
         else:
             labels = np.zeros(nrow, dtype=np.float32)
             feats = dense
-        nfeat = feats.shape[1]
+        return self._assemble(labels, feats)
+
+    def _assemble(self, labels: np.ndarray,
+                  feats: np.ndarray) -> RowBlockContainer:
+        out = RowBlockContainer(self._index_dtype)
+        nrow, nfeat = feats.shape
+        if nrow == 0:
+            return out
         index = np.tile(np.arange(nfeat, dtype=self._index_dtype), nrow)
         offset = np.arange(nrow + 1, dtype=np.int64) * nfeat
-        out.push_block(RowBlock(offset, labels, index,
-                                np.ascontiguousarray(feats).reshape(-1)))
+        out.push_block(RowBlock(offset, labels, index, feats.reshape(-1)))
         out.max_index = max(nfeat - 1, 0)
         return out
 
@@ -95,9 +105,4 @@ class CSVParser(TextParserBase):
         else:
             labels = np.zeros(len(rows), dtype=np.float32)
             feats = dense
-        nfeat = feats.shape[1]
-        index = np.tile(np.arange(nfeat, dtype=self._index_dtype), len(rows))
-        offset = np.arange(len(rows) + 1, dtype=np.int64) * nfeat
-        out.push_block(RowBlock(offset, labels, index, feats.reshape(-1)))
-        out.max_index = max(nfeat - 1, 0)
-        return out
+        return self._assemble(labels, feats)

@@ -55,7 +55,7 @@ def _load() -> Optional[ctypes.CDLL]:
         # ABI handshake: a stale build with old entry-point signatures must
         # not be called through mismatched ctypes prototypes — rebuild once,
         # and disable the native path if the rebuild still disagrees
-        _ABI = 3
+        _ABI = 4
         ver_fn = getattr(lib, "dmlc_tpu_abi_version", None)
         if ver_fn is None or int(ver_fn()) != _ABI:
             del lib
@@ -91,6 +91,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dmlc_tpu_error_msg.argtypes = [ctypes.c_void_p]
         lib.dmlc_tpu_result_fill.argtypes = [ctypes.c_void_p] + \
             [ctypes.c_void_p] * 6
+        lib.dmlc_tpu_result_fill_csv.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
         lib.dmlc_tpu_result_free.argtypes = [ctypes.c_void_p]
         lib.dmlc_tpu_find_magic.restype = ctypes.c_int64
         lib.dmlc_tpu_find_magic.argtypes = [
@@ -194,10 +196,14 @@ def parse_libfm(data, nthread: int = 4):
     return offset, label, weight, index, field, value
 
 
-def parse_csv(data, nthread: int = 4,
-              missing: float = 0.0) -> np.ndarray:
-    """Chunk (bytes or zero-copy ``(addr, len)``) -> dense [n_rows, n_cols]
-    float32.
+def parse_csv(data, nthread: int = 4, missing: float = 0.0,
+              label_column: int = -1):
+    """Chunk (bytes or zero-copy ``(addr, len)``) -> parsed CSV floats.
+
+    With ``label_column`` out of range (default) returns the dense
+    ``[n_rows, n_cols]`` float32 block.  With ``0 <= label_column <
+    n_cols`` returns ``(labels, feats)`` — the split is one C pass
+    (``dmlc_tpu_result_fill_csv``) instead of a full extra numpy copy.
 
     ``missing`` fills empty cells (reference strtof-on-empty parity = 0.0;
     NaN for sparsity-aware training).
@@ -217,6 +223,14 @@ def parse_csv(data, nthread: int = 4,
                                  ctypes.byref(flags))
         if n_rows.value < 0:
             raise ValueError(lib.dmlc_tpu_error_msg(handle).decode())
+        if 0 <= label_column < n_cols.value:
+            labels = np.empty(n_rows.value, dtype=np.float32)
+            feats = np.empty((n_rows.value, n_cols.value - 1),
+                             dtype=np.float32)
+            lib.dmlc_tpu_result_fill_csv(handle, label_column,
+                                         _ptr(labels),
+                                         _ptr(feats.reshape(-1)))
+            return labels, feats
         dense = np.empty((n_rows.value, n_cols.value), dtype=np.float32)
         lib.dmlc_tpu_result_fill(handle, None, None, None, None, None, None,
                                  _ptr(dense.reshape(-1)))

@@ -515,7 +515,7 @@ void* dmlc_tpu_parse_libfm(const char* data, int64_t len, int nthread) {
 // ABI version handshake: the ctypes bridge refuses (and rebuilds) a stale
 // library whose entry points don't match what it expects.  Bump on any
 // signature change.
-int dmlc_tpu_abi_version() { return 3; }
+int dmlc_tpu_abi_version() { return 4; }
 
 void* dmlc_tpu_parse_csv(const char* data, int64_t len, int nthread,
                          float missing) {
@@ -614,6 +614,29 @@ void dmlc_tpu_result_fill(void* handle, int64_t* offset, float* label,
   }
   if (value && !r->value.empty()) {
     memcpy(value, r->value.data(), r->value.size() * sizeof(float));
+  }
+}
+
+// One-pass label-column split of a dense CSV result: labels[i] takes
+// column label_col, feats gets the remaining n_cols-1 columns row-major.
+// Replaces a full extra numpy copy (np.delete) per chunk on the Python
+// side.  Caller guarantees 0 <= label_col < n_cols and buffers sized
+// n_rows and n_rows*(n_cols-1).
+void dmlc_tpu_result_fill_csv(void* handle, int64_t label_col, float* labels,
+                              float* feats) {
+  auto* r = static_cast<Result*>(handle);
+  const int64_t ncols = r->n_cols;
+  const int64_t nrows = r->offset.empty() ? 0 : r->offset[0];
+  if (ncols <= 0 || label_col < 0 || label_col >= ncols) return;
+  const float* src = r->dense.data();
+  const int64_t left = label_col;             // cols before the label
+  const int64_t right = ncols - label_col - 1;  // cols after it
+  for (int64_t i = 0; i < nrows; ++i) {
+    const float* row = src + i * ncols;
+    labels[i] = row[label_col];
+    float* out = feats + i * (ncols - 1);
+    if (left) memcpy(out, row, left * sizeof(float));
+    if (right) memcpy(out + left, row + label_col + 1, right * sizeof(float));
   }
 }
 
