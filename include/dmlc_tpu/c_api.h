@@ -24,7 +24,8 @@ extern "C" {
  * 4=field 8=dense. */
 void *dmlc_tpu_parse_libsvm(const char *data, int64_t len, int nthread);
 void *dmlc_tpu_parse_libfm(const char *data, int64_t len, int nthread);
-void *dmlc_tpu_parse_csv(const char *data, int64_t len, int nthread);
+void *dmlc_tpu_parse_csv(const char *data, int64_t len, int nthread,
+                         float missing);
 void dmlc_tpu_result_dims(void *handle, int64_t *n_rows, int64_t *nnz,
                           int64_t *n_cols, int32_t *flags);
 const char *dmlc_tpu_error_msg(void *handle);

@@ -36,16 +36,18 @@ struct Shard {
   std::string error_msg;
 };
 
+struct CsvShard;  // fwd
+
+// Parse results stay in the per-thread shards; fill() gathers straight
+// from them into the caller's numpy buffers.  (They were previously
+// merged into one set of vectors first — a full extra pass over
+// data-sized arrays that bought nothing, since fill() copies again.)
 struct Result {
-  std::vector<int64_t> offset;
-  std::vector<float> label;
-  std::vector<float> weight;
-  std::vector<uint32_t> index;
-  std::vector<uint32_t> field;
-  std::vector<float> value;
-  // csv
-  std::vector<float> dense;
-  int64_t n_cols = 0;
+  std::vector<Shard> shards;
+  std::vector<CsvShard> csv_shards;
+  int64_t total_rows = 0;
+  int64_t total_nnz = 0;
+  int64_t n_cols = 0;  // csv
   bool is_dense = false;
   bool has_weight = false;
   bool has_value = false;
@@ -470,30 +472,13 @@ Result* run_parse(const char* data, int64_t len, int nthread, Fn parse_fn,
     }
     any_weight |= s.any_weight;
     any_value |= s.any_value || has_field_format;  // libfm always has values
+    result->total_rows += static_cast<int64_t>(s.row_nnz.size());
+    result->total_nnz += static_cast<int64_t>(s.index.size());
   }
   result->has_weight = any_weight;
   result->has_value = any_value;
   result->has_field = has_field_format;
-  result->offset.push_back(0);
-  for (auto& s : shards) {
-    for (int64_t nnz : s.row_nnz) {
-      result->offset.push_back(result->offset.back() + nnz);
-    }
-    result->label.insert(result->label.end(), s.label.begin(), s.label.end());
-    if (any_weight) {
-      result->weight.insert(result->weight.end(), s.weight.begin(),
-                            s.weight.end());
-    }
-    result->index.insert(result->index.end(), s.index.begin(), s.index.end());
-    if (has_field_format) {
-      result->field.insert(result->field.end(), s.field.begin(),
-                           s.field.end());
-    }
-    if (any_value) {
-      result->value.insert(result->value.end(), s.value.begin(),
-                           s.value.end());
-    }
-  }
+  result->shards = std::move(shards);  // fill() gathers from these directly
   return result;
 }
 
@@ -550,14 +535,11 @@ void* dmlc_tpu_parse_csv(const char* data, int64_t len, int nthread,
     }
   }
   result->n_cols = ncols < 0 ? 0 : ncols;
-  int64_t nrows = 0;
-  for (auto& s : shards) nrows += s.n_rows;
-  result->dense.reserve(nrows * result->n_cols);
   for (auto& s : shards) {
-    result->dense.insert(result->dense.end(), s.dense.begin(), s.dense.end());
+    result->total_rows += s.n_rows;
+    result->total_nnz += static_cast<int64_t>(s.dense.size());
   }
-  // reuse offset[0] to carry the row count for dims()
-  result->offset.assign(1, nrows);
+  result->csv_shards = std::move(shards);  // fill() gathers directly
   return result;
 }
 
@@ -572,14 +554,14 @@ void dmlc_tpu_result_dims(void* handle, int64_t* n_rows, int64_t* nnz,
     return;
   }
   if (r->is_dense) {
-    *n_rows = r->offset.empty() ? 0 : r->offset[0];
-    *nnz = static_cast<int64_t>(r->dense.size());
+    *n_rows = r->total_rows;
+    *nnz = r->total_nnz;
     *n_cols = r->n_cols;
     *flags = 8;  // dense
     return;
   }
-  *n_rows = static_cast<int64_t>(r->offset.size()) - 1;
-  *nnz = static_cast<int64_t>(r->index.size());
+  *n_rows = r->total_rows;
+  *nnz = r->total_nnz;
   *n_cols = 0;
   *flags = (r->has_weight ? 1 : 0) | (r->has_value ? 2 : 0) |
            (r->has_field ? 4 : 0);
@@ -593,27 +575,44 @@ void dmlc_tpu_result_fill(void* handle, int64_t* offset, float* label,
                           float* weight, uint32_t* index, uint32_t* field,
                           float* value, float* dense) {
   auto* r = static_cast<Result*>(handle);
-  if (dense && !r->dense.empty()) {
-    memcpy(dense, r->dense.data(), r->dense.size() * sizeof(float));
+  if (dense) {
+    float* out = dense;
+    for (auto& s : r->csv_shards) {
+      if (s.dense.empty()) continue;  // memcpy from nullptr is UB even at 0
+      memcpy(out, s.dense.data(), s.dense.size() * sizeof(float));
+      out += s.dense.size();
+    }
     return;
   }
-  if (offset && !r->offset.empty()) {
-    memcpy(offset, r->offset.data(), r->offset.size() * sizeof(int64_t));
-  }
-  if (label && !r->label.empty()) {
-    memcpy(label, r->label.data(), r->label.size() * sizeof(float));
-  }
-  if (weight && !r->weight.empty()) {
-    memcpy(weight, r->weight.data(), r->weight.size() * sizeof(float));
-  }
-  if (index && !r->index.empty()) {
-    memcpy(index, r->index.data(), r->index.size() * sizeof(uint32_t));
-  }
-  if (field && !r->field.empty()) {
-    memcpy(field, r->field.data(), r->field.size() * sizeof(uint32_t));
-  }
-  if (value && !r->value.empty()) {
-    memcpy(value, r->value.data(), r->value.size() * sizeof(float));
+  int64_t row = 0, nnz_base = 0;
+  if (offset) offset[0] = 0;
+  for (auto& s : r->shards) {
+    const int64_t rows = static_cast<int64_t>(s.row_nnz.size());
+    const int64_t nnz = static_cast<int64_t>(s.index.size());
+    if (offset) {
+      int64_t run = nnz_base;
+      for (int64_t i = 0; i < rows; ++i) {
+        run += s.row_nnz[i];
+        offset[row + i + 1] = run;
+      }
+    }
+    if (label && rows) {
+      memcpy(label + row, s.label.data(), rows * sizeof(float));
+    }
+    if (weight && !s.weight.empty()) {
+      memcpy(weight + row, s.weight.data(), rows * sizeof(float));
+    }
+    if (index && nnz) {
+      memcpy(index + nnz_base, s.index.data(), nnz * sizeof(uint32_t));
+    }
+    if (field && !s.field.empty()) {
+      memcpy(field + nnz_base, s.field.data(), nnz * sizeof(uint32_t));
+    }
+    if (value && !s.value.empty()) {
+      memcpy(value + nnz_base, s.value.data(), nnz * sizeof(float));
+    }
+    row += rows;
+    nnz_base += nnz;
   }
 }
 
@@ -626,17 +625,22 @@ void dmlc_tpu_result_fill_csv(void* handle, int64_t label_col, float* labels,
                               float* feats) {
   auto* r = static_cast<Result*>(handle);
   const int64_t ncols = r->n_cols;
-  const int64_t nrows = r->offset.empty() ? 0 : r->offset[0];
   if (ncols <= 0 || label_col < 0 || label_col >= ncols) return;
-  const float* src = r->dense.data();
-  const int64_t left = label_col;             // cols before the label
+  const int64_t left = label_col;               // cols before the label
   const int64_t right = ncols - label_col - 1;  // cols after it
-  for (int64_t i = 0; i < nrows; ++i) {
-    const float* row = src + i * ncols;
-    labels[i] = row[label_col];
-    float* out = feats + i * (ncols - 1);
-    if (left) memcpy(out, row, left * sizeof(float));
-    if (right) memcpy(out + left, row + label_col + 1, right * sizeof(float));
+  int64_t base = 0;
+  for (auto& s : r->csv_shards) {
+    const float* src = s.dense.data();
+    for (int64_t i = 0; i < s.n_rows; ++i) {
+      const float* row = src + i * ncols;
+      labels[base + i] = row[label_col];
+      float* out = feats + (base + i) * (ncols - 1);
+      if (left) memcpy(out, row, left * sizeof(float));
+      if (right) {
+        memcpy(out + left, row + label_col + 1, right * sizeof(float));
+      }
+    }
+    base += s.n_rows;
   }
 }
 
