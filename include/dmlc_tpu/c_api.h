@@ -32,6 +32,12 @@ const char *dmlc_tpu_error_msg(void *handle);
 void dmlc_tpu_result_fill(void *handle, int64_t *offset, float *label,
                           float *weight, uint32_t *index, uint32_t *field,
                           float *value, float *dense);
+/* One-pass label-column split of a dense CSV result: labels gets column
+ * label_col, feats the remaining n_cols-1 columns row-major.  Caller
+ * guarantees 0 <= label_col < n_cols and buffers sized n_rows and
+ * n_rows*(n_cols-1). */
+void dmlc_tpu_result_fill_csv(void *handle, int64_t label_col, float *labels,
+                              float *feats);
 void dmlc_tpu_result_free(void *handle);
 
 /* ---- RecordIO helpers (native/parsers.cc, native/recordio.cc) ---------- */
