@@ -75,3 +75,13 @@ for FMT in libsvm libfm csv; do
             | tail -1 | sed 's/^/ours:      /'
     done
 done
+
+# 5. raw split chunk-drain (no parsing; BASELINE "Sharded split-read")
+echo "== split chunk-drain, interleaved"
+for i in $(seq "$REPS"); do
+    ref_line=$("$WORK/ref_parser_bench" "$WORK/higgs_${ROWS}.libsvm" split 2>/dev/null | tail -1)
+    [ -n "$ref_line" ] || { echo "fair driver produced no output for split" >&2; exit 1; }
+    echo "reference: $ref_line"
+    python benchmarks/bench_pipeline.py split "$WORK/higgs_${ROWS}.libsvm" 2>/dev/null \
+        | tail -1 | sed 's/^/ours:      /'
+done
