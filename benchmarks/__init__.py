@@ -1,0 +1,3 @@
+# benchmarks/ is importable so its scripts can share helpers
+# (bench_common.drain); scripts remain directly runnable via their own
+# sys.path shims.

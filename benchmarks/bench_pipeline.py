@@ -21,22 +21,10 @@ def bench_split(uri, part=0, nparts=1, type_="text"):
     from dmlc_core_tpu.utils.profiler import ThroughputMeter
 
     split = create_input_split(uri, int(part), int(nparts), type_)
-    # drain via the zero-copy (addr, len) view when the engine offers it —
-    # that is what the parser pipeline consumes; next_chunk() would add a
-    # Python-bytes copy per chunk that no real consumer pays
-    view = getattr(split, "next_chunk_view", None)
+    from benchmarks.bench_common import drain
+
     meter = ThroughputMeter("split-read")
-    while True:
-        if view is not None:
-            got = view()
-            if got is None:
-                break
-            meter.add(got[1])
-        else:
-            chunk = split.next_chunk()
-            if chunk is None:
-                break
-            meter.add(len(chunk))
+    drain(split, meter)
     split.close()
     print(meter.summary())
 
