@@ -1,0 +1,6 @@
+import sys
+
+from dmlc_core_tpu.analysis.driver import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,401 @@
+"""dmlclint driver: file walking, shared AST infra, suppressions, CLI.
+
+Findings are keyed ``<file>:<rule>:<symbol>`` and ratcheted against the
+committed ``analysis_baseline.json`` (see :mod:`.baseline`): a finding whose
+key is baselined is burn-down work and does not fail the run; a finding with
+a new key does.  ``# dmlclint: disable=<rule>`` on (or on a comment line
+immediately above) the offending line suppresses it at the source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import os
+import sys
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+__all__ = ["Finding", "FileContext", "analyze_source", "analyze_path",
+           "iter_python_files", "main", "ALL_RULES", "ROOT"]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the same target set the old scripts/lint.py walked
+TARGETS = ["dmlc_core_tpu", "tests", "examples", "bench.py",
+           "__graft_entry__.py"]
+
+# modules whose job is talking to a terminal: exempt from style-no-print
+CLI_EXEMPT = {
+    "dmlc_core_tpu/tracker/submit.py",
+    "dmlc_core_tpu/tracker/launcher.py",
+    "dmlc_core_tpu/io/__main__.py",
+    "dmlc_core_tpu/analysis/driver.py",  # this CLI reports to stdout
+}
+
+# the deep passes run on library code only; tests/examples get syntax checks
+LIBRARY_PREFIX = "dmlc_core_tpu/"
+
+ALL_RULES = {
+    "syntax": "file does not parse (never baselineable)",
+    "lockset-unsync-write": (
+        "attribute of a lock-owning class is written both under and outside "
+        "the lock"),
+    "lockset-thread-leak": (
+        "Thread target can die with an un-ferried exception (no try/except "
+        "in the target, a bare swallow, a lambda, or a library callable)"),
+    "lockset-no-join": (
+        "non-daemon Thread with no .join() on any destroy/exit path in its "
+        "owning scope"),
+    "purity-host-sync": (
+        "host synchronization inside traced code: .item()/.tolist()/"
+        "block_until_ready, or float()/int()/bool() on a traced argument"),
+    "purity-host-branch": (
+        "Python if/while branches on a value synced from a traced "
+        "computation"),
+    "purity-np-call": (
+        "numpy call on a traced argument inside traced code (executes on "
+        "host, breaks tracing)"),
+    "purity-impure-call": (
+        "impure call inside traced code: random/time/open/print/input"),
+    "resource-unclosed": (
+        "open()/socket/TemporaryFile handle neither used as a context "
+        "manager nor closed/returned/handed off in its function"),
+    "resource-tempdir": (
+        "tempfile.mkdtemp() result has no shutil.rmtree in a finally block "
+        "(leaks the dir on non-anticipated exceptions)"),
+    "style-no-print": "library code must log via utils.logging, not print()",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    lineno: int
+    symbol: str        # enclosing qualname / Class.attr — stable across moves
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}:{self.rule}:{self.symbol}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.lineno}: {self.rule} [{self.symbol}] {self.message}"
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+def build_parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.scan`` for an Attribute/Name chain; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return None
+    return ".".join(reversed(parts))
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class FileContext:
+    """Everything a pass needs about one file, computed once."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module,
+                 is_library: bool, cli_exempt: bool):
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.is_library = is_library
+        self.cli_exempt = cli_exempt
+        self.parents = build_parents(tree)
+        self.module_aliases = self._collect_aliases(tree)
+        self._defs_by_name: Optional[Dict[str, List[ast.AST]]] = None
+        self._assign_aliases: Optional[Dict[str, ast.AST]] = None
+
+    @staticmethod
+    def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+        """Local name -> imported module path (``np`` -> ``numpy``)."""
+        out: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    out[alias.asname or alias.name.split(".")[0]] = alias.name
+        return out
+
+    @property
+    def defs_by_name(self) -> Dict[str, List[ast.AST]]:
+        """Module function defs by short name — shared by the lockset
+        (thread-target resolution) and purity (root/callee resolution)
+        passes; computed once per file."""
+        if self._defs_by_name is None:
+            defs: Dict[str, List[ast.AST]] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.setdefault(node.name, []).append(node)
+            self._defs_by_name = defs
+        return self._defs_by_name
+
+    @property
+    def assign_aliases(self) -> Dict[str, ast.AST]:
+        """``name = f`` / ``name = functools.partial(f, ...)`` bindings
+        anywhere in the module, so ``kernel = partial(_kernel, ...);
+        pallas_call(kernel)`` resolves.  Collisions across scopes keep the
+        first binding — acceptable for a lint pass."""
+        if self._assign_aliases is None:
+            aliases: Dict[str, ast.AST] = {}
+            for node in ast.walk(self.tree):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                value = node.value
+                if isinstance(value, ast.Call):
+                    fname = dotted_name(value.func) or ""
+                    if fname.rsplit(".", 1)[-1] == "partial" and value.args:
+                        value = value.args[0]
+                    else:
+                        continue
+                if isinstance(value, (ast.Name, ast.Attribute, ast.Lambda)):
+                    aliases.setdefault(node.targets[0].id, value)
+            self._assign_aliases = aliases
+        return self._assign_aliases
+
+    def enclosing(self, node: ast.AST, *types) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, types):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted path of enclosing defs/classes, for stable finding keys."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            elif isinstance(cur, ast.Lambda):
+                parts.append("<lambda>")
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                symbol: Optional[str] = None) -> Finding:
+        return Finding(rule, self.relpath, getattr(node, "lineno", 0),
+                       symbol if symbol is not None else self.qualname(node),
+                       message)
+
+
+# -- suppression comments -----------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*dmlclint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+def suppressed_lines(source: str) -> Dict[int, Set[str]]:
+    """line -> suppressed rule names.  A directive on a comment-only line
+    also applies to the line below it, so rules can be silenced without
+    pushing code past the line-length limit."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        match = _SUPPRESS_RE.search(line)
+        if not match:
+            continue
+        rules = {r.strip() for r in match.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if line.lstrip().startswith("#"):
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+# -- per-file analysis --------------------------------------------------------
+
+def analyze_source(source: str, relpath: str = "<string>",
+                   is_library: Optional[bool] = None) -> List[Finding]:
+    """Run every pass over one source blob; returns sorted, unsuppressed
+    findings.  ``is_library`` defaults from the path (deep passes run on
+    ``dmlc_core_tpu/`` files; everything else is syntax-checked only)."""
+    relpath = relpath.replace(os.sep, "/")
+    if is_library is None:
+        is_library = relpath.startswith(LIBRARY_PREFIX)
+    try:
+        tree = ast.parse(source, relpath)
+    except SyntaxError as exc:
+        return [Finding("syntax", relpath, exc.lineno or 0, "<module>",
+                        f"syntax error: {exc.msg}")]
+    findings: List[Finding] = []
+    if is_library:
+        from dmlc_core_tpu.analysis import lockset, purity, resources
+
+        ctx = FileContext(relpath, source, tree, is_library,
+                          cli_exempt=relpath in CLI_EXEMPT)
+        findings += lockset.run(ctx)
+        findings += purity.run(ctx)
+        findings += resources.run(ctx)
+    supp = suppressed_lines(source)
+    findings = [f for f in findings
+                if not ({"all", f.rule} & supp.get(f.lineno, set()))]
+    return sorted(findings, key=lambda f: (f.lineno, f.rule, f.symbol))
+
+
+def repo_relpath(path: str, root: str = ROOT) -> str:
+    """Repo-relative forward-slash path used in finding keys."""
+    relpath = os.path.relpath(os.path.abspath(path), root)
+    if relpath.startswith(".."):
+        # out-of-tree file (e.g. a scratch checkout): anchor at the last
+        # dmlc_core_tpu path component so library rules still apply
+        parts = os.path.abspath(path).split(os.sep)
+        if LIBRARY_PREFIX.rstrip("/") in parts:
+            idx = len(parts) - 1 - parts[::-1].index(LIBRARY_PREFIX.rstrip("/"))
+            relpath = os.sep.join(parts[idx:])
+        else:
+            relpath = os.path.basename(path)
+    return relpath.replace(os.sep, "/")
+
+
+def analyze_path(path: str, root: str = ROOT) -> List[Finding]:
+    relpath = repo_relpath(path, root)
+    try:
+        # tokenize.open honors a PEP 263 `# -*- coding: ... -*-` line,
+        # which plain utf-8 open would reject on legacy files
+        with tokenize.open(path) as f:
+            source = f.read()
+    except (UnicodeDecodeError, LookupError, SyntaxError) as exc:
+        # undecodable bytes / bogus coding cookie: one finding, not a
+        # traceback that kills the whole gate
+        return [Finding("syntax", relpath, 0, "<module>",
+                        f"cannot decode source: {exc}")]
+    return analyze_source(source, relpath)
+
+
+def iter_python_files(paths: Optional[Sequence[str]] = None,
+                      root: str = ROOT) -> Iterable[str]:
+    targets = list(paths) if paths else [os.path.join(root, t)
+                                         for t in TARGETS]
+    for target in targets:
+        if not os.path.exists(target):
+            # a typo'd/renamed target must not pass the gate as
+            # "0 files, 0 findings"
+            raise FileNotFoundError(f"no such file or directory: {target}")
+        if os.path.isfile(target):
+            yield target
+            continue
+        for dirpath, _, files in os.walk(target):
+            if "__pycache__" in dirpath:
+                continue
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    """The dmlclint argument parser — shared with scripts/lint.py so the
+    shim's view of paths/flags can never diverge from the driver's (e.g.
+    argparse prefix abbreviations like ``--base`` for ``--baseline``)."""
+    parser = argparse.ArgumentParser(
+        prog="python -m dmlc_core_tpu.analysis",
+        description="dmlclint: lockset / JAX-purity / resource static "
+                    "analysis with a ratcheted baseline (docs/analysis.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: repo targets)")
+    parser.add_argument("--baseline",
+                        default=os.path.join(ROOT, "analysis_baseline.json"),
+                        help="baseline file (default: %(default)s)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding as new (ignore baseline)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings, "
+                             "keeping existing justifications")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="also print baselined findings")
+    parser.add_argument("--list-rules", action="store_true")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from dmlc_core_tpu.analysis import baseline as baseline_mod
+
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(ALL_RULES):
+            print(f"{rule:22s} {ALL_RULES[rule]}")
+        return 0
+
+    try:
+        files = list(iter_python_files(args.paths or None))
+    except FileNotFoundError as exc:
+        print(f"dmlclint: {exc}", file=sys.stderr)
+        return 2
+    findings: List[Finding] = []
+    for path in files:
+        findings += analyze_path(path)
+
+    try:
+        # --no-baseline only changes *reporting*; a rewrite still loads the
+        # file, else justifications (and out-of-scope keys in a path-scoped
+        # run) would be silently destroyed
+        load_it = args.write_baseline or not args.no_baseline
+        previous = baseline_mod.load(args.baseline) if load_it else {}
+    except ValueError as exc:
+        print(f"dmlclint: {exc}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        # a path-scoped rewrite must not drop entries for files it never
+        # analyzed — only the analyzed files' keys are regenerated
+        keep = {}
+        if args.paths:
+            analyzed = {repo_relpath(p) for p in files}
+            keep = {k: v for k, v in previous.items()
+                    if k.split(":", 1)[0] not in analyzed}
+        baseline_mod.save(args.baseline, findings, previous, keep=keep)
+        print(f"dmlclint: baseline written to {args.baseline} "
+              f"({len(findings)} finding(s), {len(keep)} out-of-scope "
+              f"entries kept)")
+        return 0
+
+    new, baselined, stale = baseline_mod.partition(findings, previous)
+    if args.paths:
+        # a scoped run never recomputed out-of-scope files: their baseline
+        # entries are not "fixed or moved", so don't advise pruning them
+        analyzed = {repo_relpath(p) for p in files}
+        stale = [k for k in stale if k.split(":", 1)[0] in analyzed]
+    for f in new:
+        print(f.render())
+    if args.verbose:
+        counts: Dict[str, int] = {}
+        for f in baselined:
+            key = baseline_mod._instance_key(f.key, counts)
+            note = previous.get(key, previous.get(f.key, ""))
+            print(f"{f.render()}  (baselined: {note})")
+    if stale:
+        print(f"dmlclint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed or moved — prune "
+              f"with --write-baseline):", file=sys.stderr)
+        for key in stale:
+            print(f"  {key}", file=sys.stderr)
+    print(f"dmlclint: {len(files)} files, {len(new)} new finding(s), "
+          f"{len(baselined)} baselined, {len(stale)} stale")
+    return 1 if new else 0

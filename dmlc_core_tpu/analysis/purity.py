@@ -1,0 +1,277 @@
+"""Pass 2 — JAX purity: host syncs and impurity inside traced code.
+
+A single host sync inside a ``jit``/``pallas_call`` hot path silently
+serializes the device pipeline (the 15.53M rows/s histogram figure dies on
+one stray ``.item()``); an impure call (``random``/``time``/file I/O) bakes
+a trace-time value into the compiled function and never runs again.  Neither
+crashes, which is exactly why a static pass pays rent.
+
+Mechanics, per module:
+
+1. **Roots** — functions entering tracing: decorated with ``@jax.jit`` /
+   ``@partial(jax.jit, ...)`` / ``@pl.when(...)``, or passed to a trace
+   wrapper call site (``jit``/``pjit``/``vmap``/``pmap``/``grad``/
+   ``shard_map``/``pallas_call``/``lax.scan``/``while_loop``/``fori_loop``/
+   ``cond``/``switch``).  Lambdas are analyzed inline;
+   ``functools.partial(f, ...)`` and simple ``name = f`` aliases are
+   followed.
+2. **Reachability** — from the roots, calls to same-module functions
+   (bare names, ``self.``/``cls.`` methods) are walked transitively.  The
+   walk is module-local by design: cross-module reachability would need
+   import resolution, and the gate's baseline covers the remainder.
+3. **Checks** inside reachable code:
+
+   - ``purity-host-sync``: ``.item()`` / ``.tolist()`` /
+     ``.block_until_ready()``; ``jax.device_get``; ``float()``/``int()``/
+     ``bool()`` applied to a traced parameter.  Parameters annotated
+     ``int``/``bool``/``str`` are treated as static (the idiom this package
+     uses for static args) and exempt.
+   - ``purity-host-branch``: an ``if``/``while`` test containing one of the
+     syncs above — control flow on abstract values, the
+     ``TracerBoolConversionError`` family caught before runtime.
+   - ``purity-np-call``: a ``numpy`` (not ``jax.numpy``) call taking a
+     traced parameter — executes on host, breaks the trace.  numpy on
+     constants at trace time is legitimate and not flagged.
+   - ``purity-impure-call``: ``random.*`` / ``np.random.*`` / ``time.*`` /
+     ``open`` / ``print`` / ``input`` anywhere in traced code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from dmlc_core_tpu.analysis.driver import FileContext, Finding, dotted_name
+
+__all__ = ["run", "TRACE_WRAPPERS"]
+
+# wrapper short-name -> indices of the traced-callable argument(s)
+TRACE_WRAPPERS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,), "pjit": (0,), "vmap": (0,), "pmap": (0,), "grad": (0,),
+    "value_and_grad": (0,), "checkify": (0,),
+    "shard_map": (0,), "shard_map_unchecked": (0,),
+    "pallas_call": (0,), "custom_vjp": (0,),
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "switch": (1, 2, 3, 4),
+}
+
+# decorators whose body runs under an enclosing trace (pallas predication)
+TRACE_DECORATORS = {"when"} | set(TRACE_WRAPPERS)
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_STATIC_ANNOTATIONS = {"int", "bool", "str"}
+_IMPURE_ROOTS = {"random", "time"}
+_IMPURE_CALLS = {"open", "print", "input"}
+
+_FuncNode = ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+
+
+def run(ctx: FileContext) -> List[Finding]:
+    roots = _trace_roots(ctx)
+    if not roots:
+        return []
+    traced = _reachable(ctx, roots)
+    numpy_aliases = {alias for alias, mod in ctx.module_aliases.items()
+                     if mod == "numpy" or mod.startswith("numpy.")}
+    random_aliases = {alias for alias, mod in ctx.module_aliases.items()
+                      if mod.split(".")[0] in _IMPURE_ROOTS}
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for fn in traced:
+        for f in _check_traced(ctx, fn, numpy_aliases, random_aliases):
+            dedup = (f.rule, f.lineno, f.symbol)
+            if dedup not in seen:
+                seen.add(dedup)
+                findings.append(f)
+    return findings
+
+
+# -- root discovery -----------------------------------------------------------
+
+def _wrapper_name(expr: ast.AST) -> Optional[str]:
+    name = dotted_name(expr)
+    if name is None:
+        return None
+    short = name.rsplit(".", 1)[-1]
+    return short if short in TRACE_DECORATORS else None
+
+
+def _resolve_callable(ctx: FileContext, expr: ast.AST,
+                      defs: Dict[str, List[_FuncNode]],
+                      aliases: Dict[str, ast.AST],
+                      hops: int = 0) -> List[_FuncNode]:
+    """Function defs / lambda nodes an expression may refer to."""
+    if hops > 4 or expr is None:
+        return []
+    if isinstance(expr, ast.Lambda):
+        return [expr]
+    if isinstance(expr, ast.Call):  # functools.partial(f, ...) inline
+        fname = dotted_name(expr.func) or ""
+        if fname.rsplit(".", 1)[-1] == "partial" and expr.args:
+            return _resolve_callable(ctx, expr.args[0], defs, aliases,
+                                     hops + 1)
+        return []
+    name = dotted_name(expr)
+    if name is None:
+        return []
+    short = name.rsplit(".", 1)[-1]
+    if isinstance(expr, ast.Name):
+        alias = aliases.get(short)
+        if alias is not None and alias is not expr:
+            resolved = _resolve_callable(ctx, alias, defs, aliases, hops + 1)
+            if resolved:
+                return resolved
+        return defs.get(short, [])
+    if name.startswith(("self.", "cls.")):
+        return defs.get(short, [])
+    return []
+
+
+def _trace_roots(ctx: FileContext) -> List[_FuncNode]:
+    defs = ctx.defs_by_name
+    aliases = ctx.assign_aliases
+    roots: List[_FuncNode] = []
+
+    def add(expr: ast.AST) -> None:
+        roots.extend(_resolve_callable(ctx, expr, defs, aliases))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                fname = dotted_name(base) or ""
+                if fname.rsplit(".", 1)[-1] == "partial" and \
+                        isinstance(dec, ast.Call) and dec.args:
+                    base = dec.args[0]
+                    fname = dotted_name(base) or ""
+                if _wrapper_name(base):
+                    roots.append(node)
+                    break
+        elif isinstance(node, ast.Call):
+            wrapper = _wrapper_name(node.func)
+            if wrapper is None:
+                continue
+            for idx in TRACE_WRAPPERS.get(wrapper, ()):
+                if idx < len(node.args):
+                    add(node.args[idx])
+    return roots
+
+
+def _reachable(ctx: FileContext, roots: List[_FuncNode]) -> List[_FuncNode]:
+    defs = ctx.defs_by_name
+    aliases = ctx.assign_aliases
+    seen: Set[int] = set()
+    out: List[_FuncNode] = []
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                work.extend(_resolve_callable(ctx, node.func, defs, aliases))
+    return out
+
+
+# -- checks inside traced code ------------------------------------------------
+
+def _nonstatic_params(fn: _FuncNode) -> Set[str]:
+    args = fn.args
+    names: Set[str] = set()
+    for arg in (list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)):
+        ann = getattr(arg, "annotation", None)
+        static = (isinstance(ann, ast.Name)
+                  and ann.id in _STATIC_ANNOTATIONS)
+        if arg.arg not in ("self", "cls") and not static:
+            names.add(arg.arg)
+    return names
+
+
+def _sync_call(node: ast.AST, nonstatic: Set[str]) -> Optional[str]:
+    """Message when ``node`` is a host-syncing call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
+        return (f".{func.attr}() forces a device->host sync inside traced "
+                "code")
+    name = dotted_name(func) or ""
+    if name == "jax.device_get":
+        return "jax.device_get inside traced code forces a host sync"
+    if (name in _CAST_BUILTINS and len(node.args) == 1
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id in nonstatic):
+        return (f"{name}() on traced argument {node.args[0].id!r} forces "
+                "concretization (host sync / TracerConversionError)")
+    return None
+
+
+def _np_call_on_param(node: ast.AST, nonstatic: Set[str],
+                      numpy_aliases: Set[str]) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    root = name.split(".")[0]
+    if root not in numpy_aliases:
+        return None
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        if isinstance(arg, ast.Name) and arg.id in nonstatic:
+            return (f"{name}() on traced argument {arg.id!r} executes on "
+                    "host and breaks tracing — use jax.numpy")
+    return None
+
+
+def _impure_call(node: ast.AST, random_aliases: Set[str]) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    root = name.split(".")[0]
+    if root in random_aliases or name.startswith(("np.random.",
+                                                  "numpy.random.")):
+        return (f"{name}() in traced code bakes one trace-time value into "
+                "the compiled function — thread jax.random keys instead")
+    if name in _IMPURE_CALLS:
+        return (f"{name}() is a side effect inside traced code (runs at "
+                "trace time only, or not at all)")
+    return None
+
+
+def _check_traced(ctx: FileContext, fn: _FuncNode, numpy_aliases: Set[str],
+                  random_aliases: Set[str]) -> Iterable[Finding]:
+    nonstatic = _nonstatic_params(fn)
+    # host-branch: syncs inside if/while tests get the escalated rule
+    branch_tests: Set[int] = set()
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.If, ast.While)):
+                for sub in ast.walk(node.test):
+                    branch_tests.add(id(sub))
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            sync = _sync_call(node, nonstatic)
+            if sync is not None:
+                rule = ("purity-host-branch" if id(node) in branch_tests
+                        else "purity-host-sync")
+                msg = (sync if rule == "purity-host-sync" else
+                       "Python control flow branches on a host-synced "
+                       f"traced value ({sync.strip()})")
+                yield ctx.finding(rule, node, msg)
+                continue
+            np_msg = _np_call_on_param(node, nonstatic, numpy_aliases)
+            if np_msg is not None:
+                yield ctx.finding("purity-np-call", node, np_msg)
+                continue
+            impure = _impure_call(node, random_aliases)
+            if impure is not None:
+                yield ctx.finding("purity-impure-call", node, impure)

@@ -89,16 +89,21 @@ class ThreadedIter(Generic[T]):
         cur_gen = 0
         need_reset = False
         while True:
+            epoch_ok = True
             if need_reset:
                 try:
                     self._producer.before_first()
                 except BaseException as exc:  # noqa: BLE001 - ferried to consumer
                     self._post_error(cur_gen, exc)
-                    return
-            finished = self._produce_epoch(cur_gen)
-            if finished is None:
-                return  # destroyed
-            # epoch over (EOF or reset): wait for the next generation
+                    epoch_ok = False
+            if epoch_ok:
+                finished = self._produce_epoch(cur_gen)
+                if finished is None:
+                    return  # destroyed
+            # epoch over (EOF, error, or reset): wait for the next
+            # generation.  An error ends the epoch but NOT the thread —
+            # exiting here would make every post-error before_first()
+            # restart hang the consumer forever (no producer left)
             with self._cond:
                 while not self._destroyed and self._gen == cur_gen:
                     self._cond.wait()
@@ -122,20 +127,40 @@ class ThreadedIter(Generic[T]):
             try:
                 item = self._producer.next(reuse)
             except BaseException as exc:  # noqa: BLE001
+                if reuse is not None:
+                    # the buffer was never handed to the consumer; without
+                    # this, every failed epoch shrinks the recycle pool
+                    with self._cond:
+                        self._free.append(reuse)
                 self._post_error(cur_gen, exc)
-                return None
+                return True  # epoch over; stay alive for a restart
             with self._cond:
                 if self._destroyed:
                     return None
                 if self._gen != cur_gen:
+                    # reset raced the produce: the consumer will never see
+                    # this item — re-pool its buffer (and reuse too, when
+                    # the producer ignored it and allocated fresh)
+                    if item is not None and item is not reuse:
+                        self._free.append(item)
+                    if reuse is not None:
+                        self._free.append(reuse)
                     return True
                 self._queue.append((cur_gen, _END if item is None else item))
                 self._cond.notify_all()
                 if item is None:
+                    # EOF probe: the popped reuse buffer was never consumed
+                    if reuse is not None:
+                        self._free.append(reuse)
                     return True
 
     def _post_error(self, gen: int, exc: BaseException) -> None:
         with self._cond:
+            if gen != self._gen:
+                # the consumer already abandoned this epoch via
+                # before_first(); surfacing its error into the NEXT epoch
+                # would make an otherwise-successful restart raise at EOF
+                return
             self._error = exc
             self._queue.append((gen, _END))
             self._cond.notify_all()
@@ -158,7 +183,10 @@ class ThreadedIter(Generic[T]):
                     if item is _END:
                         if self._error is not None:
                             err, self._error = self._error, None
-                            self._queue.popleft()
+                            # leave _END queued: the epoch stays "ended"
+                            # after the raise (next call returns None
+                            # instead of waiting on an epoch that will
+                            # never produce again)
                             raise err
                         return None  # leave _END queued: epoch stays "ended"
                     self._queue.popleft()
@@ -173,9 +201,14 @@ class ThreadedIter(Generic[T]):
             self._cond.notify_all()
 
     def before_first(self) -> None:
-        """Restart from the beginning (reference BeforeFirst signal protocol)."""
+        """Restart from the beginning (reference BeforeFirst signal protocol).
+
+        Discards the current epoch wholesale: queued items AND a pending
+        error both belong to the epoch being abandoned (the producer posts
+        late errors generation-checked, so none can leak in afterwards)."""
         with self._cond:
             self._gen += 1
+            self._error = None
             # drop everything already queued
             while self._queue:
                 _, item = self._queue.popleft()

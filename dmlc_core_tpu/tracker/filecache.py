@@ -104,11 +104,17 @@ def extract_archive_atomic(src: str, dest: str) -> None:
     try:
         with zipfile.ZipFile(src) as zf:
             zf.extractall(tmp)
-        os.rename(tmp, dest)
-    except OSError:
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            # rename-race loser: the winner's fully-extracted copy serves
+            if not os.path.exists(dest):
+                raise
+    finally:
+        # after a successful rename tmp no longer exists and this no-ops;
+        # on ANY failure (BadZipFile included, which the old except OSError
+        # arm leaked) the temp dir is removed
         shutil.rmtree(tmp, ignore_errors=True)
-        if not os.path.exists(dest):
-            raise
 
 
 def stage_job_dir(files: List[str], archives: List[str],

@@ -8,10 +8,10 @@ from __future__ import annotations
 
 import logging
 import subprocess
-import threading
+from functools import partial
 from typing import Dict
 
-from dmlc_core_tpu.tracker.submit import submit_job
+from dmlc_core_tpu.tracker.submit import run_ferried, submit_job
 
 __all__ = ["submit"]
 
@@ -52,12 +52,9 @@ def submit(opts) -> None:
         subprocess.check_call(cmd)
 
     def fun_submit(envs: Dict[str, str]) -> None:
-        threads = []
-        for role, n in (("server", opts.num_servers), ("worker", opts.num_workers)):
-            t = threading.Thread(target=_mpirun, args=(role, n, envs), daemon=True)
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join()
+        run_ferried([(f"mpirun for role {role}",
+                      partial(_mpirun, role, n, envs))
+                     for role, n in (("server", opts.num_servers),
+                                     ("worker", opts.num_workers))])
 
     submit_job(opts, fun_submit, wait=False)

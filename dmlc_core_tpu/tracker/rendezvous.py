@@ -371,6 +371,7 @@ class PSTracker:
                  port_end: int = 9999, envs: Optional[dict] = None):
         self.host_ip = host_ip
         self.cmd = cmd
+        self._error: Optional[BaseException] = None
         if cmd:
             sock, self.port = bind_free_port(host_ip, port, port_end)
             sock.close()  # scheduler process rebinds it
@@ -379,9 +380,15 @@ class PSTracker:
             env["DMLC_ROLE"] = "scheduler"
             env["DMLC_PS_ROOT_URI"] = str(host_ip)
             env["DMLC_PS_ROOT_PORT"] = str(self.port)
-            self.thread = threading.Thread(
-                target=lambda: subprocess.check_call(cmd, shell=True, env=env),
-                daemon=True)
+
+            def _run_scheduler() -> None:
+                try:
+                    subprocess.check_call(cmd, shell=True, env=env)
+                except BaseException as exc:  # noqa: BLE001 - ferried to join
+                    logger.error("ps scheduler failed: %s", exc)
+                    self._error = exc
+
+            self.thread = threading.Thread(target=_run_scheduler, daemon=True)
             self.thread.start()
         else:
             self.port = None
@@ -396,3 +403,7 @@ class PSTracker:
     def join(self) -> None:
         if self.thread is not None:
             self.thread.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                f"ps-lite scheduler {self.cmd!r} failed") from err

@@ -7,13 +7,15 @@ including cloud credentials (26-27).
 
 from __future__ import annotations
 
+import inspect
 import logging
 import os
 import subprocess
-import threading
+from functools import partial
 from typing import Dict, List, Tuple
 
-from dmlc_core_tpu.tracker.submit import submit_job
+from dmlc_core_tpu.tracker.filecache import extract_archive_atomic
+from dmlc_core_tpu.tracker.submit import run_ferried, submit_job
 
 __all__ = ["submit", "parse_host_file"]
 
@@ -67,21 +69,14 @@ def ship_files(specs: List[str], host: str, port: int,
         subprocess.check_call(cmd)
 
 
-# remote one-liner: extract into a temp dir, rename into place — dest only
-# ever appears fully extracted, and concurrent workers on one host race
-# safely (same dance as filecache.extract_archive_atomic)
+# remote unpack program: the REAL filecache.extract_archive_atomic source
+# (stdlib-only by construction), not a hand-maintained string twin — the
+# twins drifted once already (the BadZipFile temp-dir leak was fixed in
+# the function but originally shipped in both copies)
 _REMOTE_UNZIP = (
-    "import os,shutil,sys,tempfile,zipfile\n"
-    "src, dest = sys.argv[1:3]\n"
-    "if not os.path.exists(dest):\n"
-    "    tmp = tempfile.mkdtemp(prefix='.dmlc-unpack-', dir='.')\n"
-    "    try:\n"
-    "        zipfile.ZipFile(src).extractall(tmp)\n"
-    "        os.rename(tmp, dest)\n"
-    "    except OSError:\n"
-    "        shutil.rmtree(tmp, ignore_errors=True)\n"
-    "        if not os.path.exists(dest):\n"
-    "            raise\n")
+    "import os, shutil, sys, tempfile, zipfile\n"
+    + inspect.getsource(extract_archive_atomic)
+    + "extract_archive_atomic(sys.argv[1], sys.argv[2])\n")
 
 
 def _unpack_prelude(archives: List[str]) -> str:
@@ -134,7 +129,7 @@ def submit(opts) -> None:
                 sync_dir(os.getcwd(), host, port, opts.sync_dst_dir)
         for host, port in set(hosts):
             ship_files(shipped, host, port, workdir)
-        threads = []
+        tasks = []
         for i in range(opts.num_workers + opts.num_servers):
             role = "server" if i < opts.num_servers else "worker"
             taskid = i if role == "server" else i - opts.num_servers
@@ -147,11 +142,8 @@ def submit(opts) -> None:
                     env.setdefault(key, os.environ[key])
             cmd = _ssh_command(host, port, env, workdir, command,
                                prelude=prelude)
-            t = threading.Thread(target=subprocess.check_call, args=(cmd,),
-                                 daemon=True)
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join()
+            tasks.append((f"ssh task {role}:{taskid}",
+                          partial(subprocess.check_call, cmd)))
+        run_ferried(tasks)
 
     submit_job(opts, fun_submit, wait=False)

@@ -15,16 +15,44 @@ Env contract handed to every worker (SURVEY.md §5.6):
 from __future__ import annotations
 
 import logging
-import os
 import socket
 import sys
-from typing import Callable, Dict, Optional
+import threading
+from typing import Callable, Dict, List, Sequence, Tuple
 
 from dmlc_core_tpu.tracker.rendezvous import PSTracker, RabitTracker, bind_free_port
 
-__all__ = ["submit_job", "main"]
+__all__ = ["submit_job", "run_ferried", "main"]
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+def run_ferried(tasks: Sequence[Tuple[str, Callable[[], None]]]) -> None:
+    """Run ``(label, thunk)`` tasks on daemon threads, join them all, and
+    re-raise the first failure.
+
+    The one ferrying stanza shared by the ssh/mpi/tpu-vm backends: a thread
+    target that raises dies silently in ``Thread.run`` and ``join()``
+    reports success over a dead task (the dmlclint lockset-thread-leak
+    rule), so every task's exception is logged under its label and the
+    first one propagates to the caller after all tasks finish."""
+    errors: List[BaseException] = []
+
+    def run(label: str, thunk: Callable[[], None]) -> None:
+        try:
+            thunk()
+        except BaseException as exc:  # noqa: BLE001 - ferried to caller
+            logger.error("%s failed: %s", label, exc)
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run, args=(label, thunk), daemon=True)
+               for label, thunk in tasks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
 
 
 def _default_host_ip() -> str:

@@ -23,11 +23,11 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
-import threading
 import uuid
+from functools import partial
 from typing import Dict
 
-from dmlc_core_tpu.tracker.submit import submit_job
+from dmlc_core_tpu.tracker.submit import run_ferried, submit_job
 from dmlc_core_tpu.tracker.ssh import (FORWARD_ENV, _shquote, _ssh_command,
                                        parse_host_file)
 
@@ -78,7 +78,7 @@ def submit(opts) -> None:
             workdir = opts.sync_dst_dir or "."
             for host, port in set(hosts[:opts.num_workers]):
                 ship_files(shipped, host, port, workdir)
-            threads = []
+            tasks = []
             for taskid in range(opts.num_workers):
                 host, port = hosts[taskid]
                 env = dict(base_env)
@@ -86,12 +86,9 @@ def submit(opts) -> None:
                 env["DMLC_TASK_ID"] = str(taskid)
                 cmd = _ssh_command(host, port, env, workdir, command,
                                    prelude=prelude)
-                t = threading.Thread(target=subprocess.check_call, args=(cmd,),
-                                     daemon=True)
-                t.start()
-                threads.append(t)
-            for t in threads:
-                t.join()
+                tasks.append((f"tpu-vm worker {taskid}",
+                              partial(subprocess.check_call, cmd)))
+            run_ferried(tasks)
         else:
             # gcloud path: the TPU runtime provides per-host task ids via
             # TPU_WORKER_ID; _gcloud_cmd emits the (unquoted, host-side)

@@ -1,0 +1,778 @@
+"""dmlclint (dmlc_core_tpu.analysis) tests: every rule has a fixture that
+must trip and a clean twin that must not, plus suppression-comment,
+baseline-ratchet round-trip, and CLI exit-code coverage.
+
+Fixtures are analyzed via ``analyze_source(src, relpath)`` with a
+``dmlc_core_tpu/``-prefixed relpath so the deep passes run (non-library
+paths get syntax checks only).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dmlc_core_tpu.analysis import analyze_source
+from dmlc_core_tpu.analysis import baseline as baseline_mod
+from dmlc_core_tpu.analysis.driver import ALL_RULES, Finding, main
+
+LIB = "dmlc_core_tpu/_fixture.py"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src, relpath=LIB):
+    return [f.rule for f in analyze_source(textwrap.dedent(src), relpath)]
+
+
+def findings_of(src, relpath=LIB):
+    return analyze_source(textwrap.dedent(src), relpath)
+
+
+# -- syntax -------------------------------------------------------------------
+
+def test_syntax_error_trips():
+    [f] = findings_of("def broken(:\n    pass\n")
+    assert f.rule == "syntax"
+    assert f.lineno == 1
+
+
+def test_syntax_checked_outside_library_too():
+    assert rules_of("def broken(:\n", relpath="tests/x.py") == ["syntax"]
+    # ...but deep passes do NOT run outside the library prefix
+    assert rules_of("print('hi')\n", relpath="tests/x.py") == []
+
+
+# -- lockset-unsync-write -----------------------------------------------------
+
+UNSYNC = """
+    import threading
+
+    class Buf:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0          # ctor write: allowed
+
+        def add(self):
+            with self._lock:
+                self._n += 1
+
+        def reset(self):
+            self._n = 0          # bare write: trips
+"""
+
+
+def test_lockset_unsync_write_trips():
+    [f] = findings_of(UNSYNC)
+    assert f.rule == "lockset-unsync-write"
+    assert f.symbol == "Buf._n"
+
+
+def test_lockset_unsync_write_clean_twin():
+    clean = UNSYNC.replace("            self._n = 0          # bare",
+                           "            with self._lock:\n"
+                           "                self._n = 0  # locked")
+    assert rules_of(clean) == []
+
+
+def test_lockset_ignores_classes_without_locks():
+    assert rules_of("""
+        class Plain:
+            def set(self, v):
+                self.v = v
+    """) == []
+
+
+# -- lockset-thread-leak ------------------------------------------------------
+
+def test_thread_leak_library_callable_trips():
+    [f] = findings_of("""
+        import subprocess
+        import threading
+
+        def launch(cmd):
+            t = threading.Thread(target=subprocess.check_call, args=(cmd,),
+                                 daemon=True)
+            t.start()
+            t.join()
+    """)
+    assert f.rule == "lockset-thread-leak"
+    assert "subprocess.check_call" in f.symbol
+
+
+def test_thread_leak_lambda_trips():
+    rules = rules_of("""
+        import threading
+
+        def go(cmd):
+            t = threading.Thread(target=lambda: run(cmd), daemon=True)
+            t.start()
+            t.join()
+    """)
+    assert "lockset-thread-leak" in rules
+
+
+def test_thread_leak_no_try_trips():
+    rules = rules_of("""
+        import threading
+
+        def go(cmd):
+            def work():
+                do_thing(cmd)
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            t.join()
+    """)
+    assert rules == ["lockset-thread-leak"]
+
+
+def test_thread_leak_bare_swallow_still_trips():
+    rules = rules_of("""
+        import threading
+
+        def go(cmd):
+            def work():
+                try:
+                    do_thing(cmd)
+                except Exception:
+                    pass
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            t.join()
+    """)
+    assert rules == ["lockset-thread-leak"]
+
+
+def test_thread_leak_clean_twin_ferries():
+    assert rules_of("""
+        import threading
+
+        def go(cmd):
+            errors = []
+
+            def work():
+                try:
+                    do_thing(cmd)
+                except Exception as exc:
+                    errors.append(exc)
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+            t.join()
+            if errors:
+                raise errors[0]
+    """) == []
+
+
+# -- lockset-no-join ----------------------------------------------------------
+
+def test_no_join_trips():
+    [f] = findings_of("""
+        import threading
+
+        def fire(cb):
+            def work():
+                try:
+                    cb()
+                except Exception as exc:
+                    log(exc)
+            threading.Thread(target=work).start()
+    """)
+    assert f.rule == "lockset-no-join"
+
+
+def test_no_join_clean_when_joined():
+    assert rules_of("""
+        import threading
+
+        def fire(cb):
+            def work():
+                try:
+                    cb()
+                except Exception as exc:
+                    log(exc)
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+    """) == []
+
+
+def test_no_join_clean_when_daemon():
+    assert rules_of("""
+        import threading
+
+        def fire(cb):
+            def work():
+                try:
+                    cb()
+                except Exception as exc:
+                    log(exc)
+            threading.Thread(target=work, daemon=True).start()
+    """) == []
+
+
+def test_no_join_self_thread_checks_whole_class():
+    # Thread stored on self in one method, joined from another: clean.
+    assert rules_of("""
+        import threading
+
+        class Owner:
+            def start(self):
+                def work():
+                    try:
+                        step()
+                    except Exception as exc:
+                        log(exc)
+                self._t = threading.Thread(target=work)
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+    """) == []
+
+
+# -- purity: roots + reachability ---------------------------------------------
+
+def test_purity_untraced_code_is_exempt():
+    # .item() outside any traced function: host code is allowed to sync.
+    assert rules_of("""
+        def summarize(x):
+            return x.item()
+    """) == []
+
+
+def test_purity_host_sync_item_trips():
+    [f] = findings_of("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+    """)
+    assert f.rule == "purity-host-sync"
+    assert f.symbol == "step"
+
+
+def test_purity_host_sync_float_on_traced_arg():
+    rules = rules_of("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x)
+    """)
+    assert rules == ["purity-host-sync"]
+
+
+def test_purity_static_annotation_exempts_cast():
+    assert rules_of("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=(1,))
+        def step(x, n: int):
+            return x * float(n)
+    """) == []
+
+
+def test_purity_reaches_transitive_callees():
+    [f] = findings_of("""
+        import jax
+
+        def helper(x):
+            return x.tolist()
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+    """)
+    assert f.rule == "purity-host-sync"
+    assert f.symbol == "helper"
+
+
+def test_purity_call_site_roots_pallas_and_scan():
+    # roots via call sites (not decorators): pallas_call(kernel), lax.scan
+    rules = rules_of("""
+        import jax
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            print("trace me")
+            o_ref[...] = x_ref[...]
+
+        def launch(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+    """)
+    # the print also trips the style rule; the purity pass must see the
+    # kernel as traced via the pallas_call call site
+    assert "purity-impure-call" in rules
+
+
+def test_purity_partial_alias_root():
+    rules = rules_of("""
+        import jax
+        from functools import partial
+
+        def _kernel(n, x):
+            return float(x)
+
+        kernel = partial(_kernel, 4)
+
+        def launch(x):
+            return jax.jit(kernel)(x)
+    """)
+    assert rules == ["purity-host-sync"]
+
+
+# -- purity-host-branch -------------------------------------------------------
+
+def test_purity_host_branch_trips():
+    [f] = findings_of("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            if float(x) > 0:
+                return x
+            return -x
+    """)
+    assert f.rule == "purity-host-branch"
+
+
+# -- purity-np-call -----------------------------------------------------------
+
+def test_purity_np_call_trips():
+    [f] = findings_of("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            return np.sum(x)
+    """)
+    assert f.rule == "purity-np-call"
+
+
+def test_purity_jnp_is_clean():
+    assert rules_of("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return jnp.sum(x)
+    """) == []
+
+
+def test_purity_np_on_constant_is_clean():
+    # numpy at trace time on non-traced values is legitimate
+    assert rules_of("""
+        import jax
+        import numpy as np
+
+        TABLE = np.arange(16)
+
+        @jax.jit
+        def step(x):
+            return x + np.float32(1.5)
+    """) == []
+
+
+# -- purity-impure-call -------------------------------------------------------
+
+@pytest.mark.parametrize("call", ["random.random()", "time.time()",
+                                  "np.random.rand(3)", "open('f')",
+                                  "print(1)"])
+def test_purity_impure_calls_trip(call):
+    rules = rules_of(f"""
+        import random
+        import time
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = {call}
+            return x
+    """)
+    assert "purity-impure-call" in rules or "purity-np-call" in rules
+
+
+def test_purity_jax_random_is_clean():
+    assert rules_of("""
+        import jax
+
+        @jax.jit
+        def step(key, x):
+            return x + jax.random.normal(key, x.shape)
+    """) == []
+
+
+# -- resource-unclosed --------------------------------------------------------
+
+def test_resource_unclosed_bare_expression_trips():
+    [f] = findings_of("""
+        def touch(p):
+            open(p, "w")
+    """)
+    assert f.rule == "resource-unclosed"
+
+
+def test_resource_unclosed_never_closed_local_trips():
+    [f] = findings_of("""
+        def read(p):
+            f = open(p)
+            data = f.read()
+            return data
+    """)
+    assert f.rule == "resource-unclosed"
+
+
+@pytest.mark.parametrize("src", [
+    # with-statement
+    "def read(p):\n    with open(p) as f:\n        return f.read()\n",
+    # explicit close
+    "def read(p):\n    f = open(p)\n    try:\n        return f.read()\n"
+    "    finally:\n        f.close()\n",
+    # ownership returned
+    "def make(p):\n    return open(p)\n",
+    # handed to a wrapper call
+    "import io\ndef make(p):\n    return io.BufferedReader(open(p, 'rb'))\n",
+    # class-owned lifecycle
+    "class S:\n    def open(self, p):\n        self._f = open(p)\n"
+    "    def close(self):\n        self._f.close()\n",
+])
+def test_resource_unclosed_clean_twins(src):
+    assert rules_of(src) == []
+
+
+def test_resource_socket_trips():
+    [f] = findings_of("""
+        import socket
+
+        def probe(host):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.connect((host, 80))
+    """)
+    assert f.rule == "resource-unclosed"
+
+
+# -- resource-tempdir ---------------------------------------------------------
+
+def test_tempdir_except_arm_cleanup_trips():
+    # cleanup only in `except OSError` leaks on every other exception type
+    [f] = findings_of("""
+        import os
+        import shutil
+        import tempfile
+        import zipfile
+
+        def unpack(src, dest):
+            tmp = tempfile.mkdtemp()
+            try:
+                zipfile.ZipFile(src).extractall(tmp)
+                os.rename(tmp, dest)
+            except OSError:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+    """)
+    assert f.rule == "resource-tempdir"
+
+
+def test_tempdir_finally_cleanup_is_clean():
+    assert rules_of("""
+        import shutil
+        import tempfile
+
+        def work(fn):
+            tmp = tempfile.mkdtemp()
+            try:
+                fn(tmp)
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+    """) == []
+
+
+def test_tempdir_returned_is_clean():
+    assert rules_of("""
+        import tempfile
+
+        def scratch():
+            tmp = tempfile.mkdtemp()
+            return tmp
+    """) == []
+
+
+# -- style-no-print -----------------------------------------------------------
+
+def test_no_print_trips_in_library():
+    [f] = findings_of("print('dbg')\n")
+    assert f.rule == "style-no-print"
+
+
+def test_no_print_exempts_cli_modules():
+    assert rules_of("print('usage: ...')\n",
+                    relpath="dmlc_core_tpu/tracker/submit.py") == []
+
+
+# -- suppression comments -----------------------------------------------------
+
+def test_suppression_same_line():
+    assert rules_of(
+        "print('x')  # dmlclint: disable=style-no-print\n") == []
+
+
+def test_suppression_line_above():
+    assert rules_of(
+        "# dmlclint: disable=style-no-print\nprint('x')\n") == []
+
+
+def test_suppression_all_and_wrong_rule():
+    assert rules_of("print('x')  # dmlclint: disable=all\n") == []
+    # a directive for a different rule does not suppress
+    assert rules_of(
+        "print('x')  # dmlclint: disable=resource-unclosed\n") == \
+        ["style-no-print"]
+
+
+# -- baseline ratchet ---------------------------------------------------------
+
+def _finding(rule="style-no-print", path="dmlc_core_tpu/x.py",
+             symbol="f", lineno=3):
+    return Finding(rule, path, lineno, symbol, "msg")
+
+
+def test_baseline_round_trip(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    old = _finding(symbol="old")
+    baseline_mod.save(path, [old], {old.key: "known; burn down"})
+    loaded = baseline_mod.load(path)
+    assert loaded == {old.key: "known; burn down"}
+
+    # same finding at a DIFFERENT line still matches (symbol-keyed ratchet)
+    moved = _finding(symbol="old", lineno=99)
+    new, baselined, stale = baseline_mod.partition([moved], loaded)
+    assert (new, [f.key for f in baselined], stale) == \
+        ([], [old.key], [])
+
+    # a new symbol is a new finding; a fixed one shows up stale
+    fresh = _finding(symbol="fresh")
+    new, baselined, stale = baseline_mod.partition([fresh], loaded)
+    assert [f.key for f in new] == [fresh.key]
+    assert baselined == [] and stale == [old.key]
+
+
+def test_baseline_rewrite_keeps_justifications(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    f1, f2 = _finding(symbol="a"), _finding(symbol="b")
+    baseline_mod.save(path, [f1], {f1.key: "reviewed: safe"})
+    baseline_mod.save(path, [f1, f2], baseline_mod.load(path))
+    data = baseline_mod.load(path)
+    assert data[f1.key] == "reviewed: safe"
+    assert "TODO" in data[f2.key]
+
+
+def test_corrupt_baseline_is_a_usage_error_not_empty(tmp_path, capsys):
+    # a truncated/empty baseline silently read as {} would report every
+    # baselined finding as new — fail loudly with the CLI usage exit instead
+    pkg = _write_pkg(tmp_path, "print('oops')\n")
+    bl = tmp_path / "baseline.json"
+    for blob in ("", "[1, 2]", '{"findings": ', '{"findings": [1, 2]}'):
+        bl.write_text(blob)
+        with pytest.raises(ValueError, match="unreadable baseline"):
+            baseline_mod.load(str(bl))
+        assert main([pkg, "--baseline", str(bl)]) == 2
+        assert "unreadable baseline" in capsys.readouterr().err
+
+
+def test_second_instance_of_baselined_finding_still_fails(tmp_path):
+    """Regression: keys carry no line numbers, so a SECOND violation of an
+    already-baselined rule in the same symbol used to collapse onto the
+    baselined key and ship silently; instance keys (`key#2`...) close it."""
+    one = _finding(symbol="load", lineno=10)
+    two = _finding(symbol="load", lineno=20)
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.save(path, [one], {one.key: "known leak; burn down"})
+    loaded = baseline_mod.load(path)
+    # the original instance stays baselined; the new one is NEW
+    new, baselined, stale = baseline_mod.partition([one, two], loaded)
+    assert [f.lineno for f in baselined] == [10]
+    assert [f.lineno for f in new] == [20] and stale == []
+    # rewriting with both instances baselines the second under key#2
+    baseline_mod.save(path, [one, two], loaded)
+    loaded = baseline_mod.load(path)
+    assert set(loaded) == {one.key, f"{one.key}#2"}
+    assert loaded[one.key] == "known leak; burn down"
+    new, baselined, stale = baseline_mod.partition([one, two], loaded)
+    assert new == [] and len(baselined) == 2 and stale == []
+    # fixing one instance leaves #2 stale, not silently absorbed
+    new, baselined, stale = baseline_mod.partition([one], loaded)
+    assert new == [] and stale == [f"{one.key}#2"]
+
+
+def test_baseline_never_accepts_syntax_findings(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    syn = _finding(rule="syntax", symbol="<module>")
+    baseline_mod.save(path, [syn], {})
+    assert baseline_mod.load(path) == {}
+    new, baselined, _ = baseline_mod.partition(
+        [syn], {syn.key: "cannot happen"})
+    assert [f.rule for f in new] == ["syntax"] and baselined == []
+
+
+# -- driver CLI ---------------------------------------------------------------
+
+def _write_pkg(tmp_path, body):
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    mod = pkg / "victim.py"
+    mod.write_text(textwrap.dedent(body))
+    return str(pkg)
+
+
+def test_cli_exit_codes_and_ratchet(tmp_path, capsys):
+    pkg = _write_pkg(tmp_path, "print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    # no baseline file: the finding is new -> exit 1
+    assert main([pkg, "--baseline", bl]) == 1
+    assert "style-no-print" in capsys.readouterr().out
+    # write the baseline: subsequent runs ratchet it away -> exit 0
+    assert main([pkg, "--baseline", bl, "--write-baseline"]) == 0
+    assert main([pkg, "--baseline", bl]) == 0
+    # a NEW finding on top of the baselined one still fails
+    mod = tmp_path / "dmlc_core_tpu" / "victim.py"
+    mod.write_text(mod.read_text() + "def leak(p):\n    open(p, 'w')\n")
+    assert main([pkg, "--baseline", bl]) == 1
+    out = capsys.readouterr().out
+    assert "resource-unclosed" in out and "style-no-print" not in out
+    # --no-baseline reports everything
+    assert main([pkg, "--baseline", bl, "--no-baseline"]) == 1
+
+
+def test_write_baseline_scoped_run_keeps_other_entries(tmp_path, capsys):
+    """Regression: `--write-baseline <path>` must not drop baseline entries
+    for files outside <path> (their findings were never recomputed)."""
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("print('a')\n")
+    (pkg / "b.py").write_text("print('b')\n")
+    bl = str(tmp_path / "baseline.json")
+    assert main([str(pkg), "--baseline", bl, "--write-baseline"]) == 0
+    full = baseline_mod.load(bl)
+    assert len(full) == 2
+    # rewrite scoped to a.py only: b.py's entry must survive verbatim
+    assert main([str(pkg / "a.py"), "--baseline", bl,
+                 "--write-baseline"]) == 0
+    assert baseline_mod.load(bl) == full
+    # a rewrite whose scope covers a now-fixed file still prunes its entry
+    (pkg / "b.py").write_text("pass\n")
+    assert main([str(pkg), "--baseline", bl, "--write-baseline"]) == 0
+    assert len(baseline_mod.load(bl)) == 1
+    capsys.readouterr()
+
+
+def test_write_baseline_under_no_baseline_keeps_justifications(tmp_path,
+                                                               capsys):
+    """Regression: `--no-baseline --write-baseline` used to compute the
+    rewrite from previous={} — wiping every justification (and, in a
+    path-scoped run, dropping out-of-scope entries entirely)."""
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("print('a')\n")
+    (pkg / "b.py").write_text("print('b')\n")
+    bl = tmp_path / "baseline.json"
+    assert main([str(pkg), "--baseline", str(bl), "--write-baseline"]) == 0
+    data = json.loads(bl.read_text())
+    data["findings"] = {k: "reviewed: safe" for k in data["findings"]}
+    bl.write_text(json.dumps(data))
+    full = baseline_mod.load(str(bl))
+    # a path-scoped rewrite under --no-baseline keeps scope AND text
+    assert main([str(pkg / "a.py"), "--baseline", str(bl), "--no-baseline",
+                 "--write-baseline"]) == 0
+    assert baseline_mod.load(str(bl)) == full
+    capsys.readouterr()
+
+
+def test_scoped_run_does_not_report_out_of_scope_entries_stale(tmp_path,
+                                                               capsys):
+    """Regression: a path-scoped gate run reported every baseline entry for
+    un-analyzed files as 'stale (fixed or moved)' with prune advice that
+    would have dropped live entries."""
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("print('a')\n")
+    (pkg / "b.py").write_text("print('b')\n")
+    bl = str(tmp_path / "baseline.json")
+    assert main([str(pkg), "--baseline", bl, "--write-baseline"]) == 0
+    capsys.readouterr()
+    # scoped to a.py: b.py's entry is out of scope, not stale
+    assert main([str(pkg / "a.py"), "--baseline", bl]) == 0
+    captured = capsys.readouterr()
+    assert "stale baseline entr" not in captured.err
+    assert "0 stale" in captured.out
+    # fixing a.py and re-running scoped DOES report its entry stale
+    (pkg / "a.py").write_text("pass\n")
+    assert main([str(pkg / "a.py"), "--baseline", bl]) == 0
+    captured = capsys.readouterr()
+    assert "1 stale baseline entry" in captured.err
+    assert "a.py" in captured.err and "b.py" not in captured.err
+
+
+def test_non_utf8_source_is_a_finding_not_a_crash(tmp_path):
+    """Regression: analyze_path hard-coded utf-8 — a PEP 263 latin-1 file
+    crashed the whole gate with UnicodeDecodeError."""
+    from dmlc_core_tpu.analysis import analyze_path
+
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    legacy = pkg / "legacy.py"
+    legacy.write_bytes(b"# -*- coding: latin-1 -*-\ns = '\xe9'\n")
+    assert analyze_path(str(legacy)) == []  # cookie honored, parses clean
+    bad = pkg / "bad.py"
+    bad.write_bytes(b"s = '\xff\xfe'\n")  # invalid utf-8, no cookie
+    findings = analyze_path(str(bad))
+    assert [f.rule for f in findings] == ["syntax"]
+    assert "cannot decode" in findings[0].message
+
+
+def test_cli_missing_path_is_an_error(tmp_path, capsys):
+    """Regression: a typo'd/renamed path must not pass the gate as
+    '0 files, 0 findings' — the old walker silently yielded nothing."""
+    assert main([str(tmp_path / "no" / "such" / "path.py")]) == 2
+    assert "no such file" in capsys.readouterr().err
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule in out
+
+
+def test_repo_is_clean_under_committed_baseline():
+    """The acceptance gate itself: the analyzer exits 0 on this repo."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.analysis"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 new finding(s)" in proc.stdout
+
+
+def test_committed_baseline_has_no_todo_placeholders():
+    """Every baselined finding must carry a real justification."""
+    path = os.path.join(REPO, "analysis_baseline.json")
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    for key, why in data["findings"].items():
+        assert "TODO" not in why, f"unjustified baseline entry: {key}"
+
+
+def test_lint_shim_delegates_to_analyzer(tmp_path):
+    """scripts/lint.py keeps its exit-code contract via dmlclint."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "dmlclint" in proc.stdout

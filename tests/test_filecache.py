@@ -154,6 +154,49 @@ def test_extract_archive_atomic_concurrent(tmp_path):
             if p.name.startswith(".dmlc-unpack-")] == []
 
 
+def test_extract_archive_atomic_bad_zip_cleans_temp(tmp_path):
+    """Regression (surfaced by dmlclint resource-tempdir): cleanup lived in
+    an ``except OSError`` arm, so a corrupt archive (BadZipFile, not an
+    OSError) left the .dmlc-unpack-* temp dir behind on every attempt."""
+    from dmlc_core_tpu.tracker.filecache import extract_archive_atomic
+
+    bad = tmp_path / "corrupt.zip"
+    bad.write_bytes(b"this is not a zip file")
+    dest = tmp_path / "out"
+    with pytest.raises(zipfile.BadZipFile):
+        extract_archive_atomic(str(bad), str(dest))
+    assert not dest.exists()
+    assert [p for p in tmp_path.iterdir()
+            if p.name.startswith(".dmlc-unpack-")] == []
+
+
+def test_remote_unzip_oneliner_bad_zip_cleans_temp(tmp_path):
+    """The ssh backends' remote unpack one-liner must match
+    extract_archive_atomic: a corrupt zip fails the task AND leaves no
+    .dmlc-unpack-* temp dir behind in the remote workdir."""
+    from dmlc_core_tpu.tracker.ssh import _REMOTE_UNZIP
+
+    bad = tmp_path / "corrupt.zip"
+    bad.write_bytes(b"this is not a zip file")
+    proc = subprocess.run(
+        [sys.executable, "-c", _REMOTE_UNZIP, str(bad), "out"],
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "BadZipFile" in proc.stderr
+    assert not (tmp_path / "out").exists()
+    assert [p for p in tmp_path.iterdir()
+            if p.name.startswith(".dmlc-unpack-")] == []
+    # and the good-zip path still extracts
+    ok = tmp_path / "ok.zip"
+    with zipfile.ZipFile(ok, "w") as zf:
+        zf.writestr("inner.txt", "hi\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", _REMOTE_UNZIP, str(ok), "okdir"],
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert (tmp_path / "okdir" / "inner.txt").read_text() == "hi\n"
+
+
 def test_launcher_materializes_files(tmp_path, monkeypatch):
     from dmlc_core_tpu.tracker.launcher import materialize_files
 

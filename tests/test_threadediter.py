@@ -114,6 +114,201 @@ def test_iterator_factory_adapter():
     it.destroy()
 
 
+def test_error_then_before_first_never_hangs():
+    """Regression: a producer error posted before a before_first() used to
+    kill the producer thread while the reset drained the error's _END
+    marker — the next consumer next() waited forever.  Now the producer
+    survives, the abandoned epoch's error is discarded with its items, and
+    the restarted epoch (which fails again here) posts a fresh error."""
+
+    class Boom:
+        def before_first(self):
+            pass
+
+        def next(self, reuse):
+            raise ValueError("producer exploded")
+
+    it = ThreadedIter(Boom(), max_capacity=2)
+    # wait until the producer has posted the first epoch's error
+    for _ in range(500):
+        with it._cond:
+            if it._error is not None:
+                break
+        time.sleep(0.01)
+    it.before_first()
+
+    result = []
+
+    def consume():
+        try:
+            it.next()
+            result.append(None)
+        except BaseException as exc:  # noqa: BLE001
+            result.append(exc)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "next() hung after before_first() ate the error"
+    assert isinstance(result[0], ValueError)
+    it.destroy()
+
+
+def test_stale_error_does_not_leak_into_restarted_epoch():
+    """Regression (review repro): epoch 0 fails, the consumer resets
+    WITHOUT consuming the error, epoch 1 succeeds — the stale epoch-0
+    error must not surface mid-epoch-1 or at its EOF."""
+
+    class FlakyFirstEpoch:
+        def __init__(self):
+            self.fail = True
+            self.i = 0
+
+        def before_first(self):
+            self.fail = False
+            self.i = 0
+
+        def next(self, reuse):
+            if self.fail:
+                raise ValueError("boom")
+            if self.i >= 3:
+                return None
+            self.i += 1
+            return [self.i - 1]
+
+    it = ThreadedIter(FlakyFirstEpoch(), max_capacity=2)
+    # wait for epoch 0's error, then reset without ever seeing it
+    for _ in range(500):
+        with it._cond:
+            if it._error is not None:
+                break
+        time.sleep(0.01)
+    it.before_first()
+    assert drain(it) == [0, 1, 2]   # clean epoch: no ValueError anywhere
+    assert it.next() is None        # ...and a clean sticky EOF
+    it.destroy()
+
+
+def test_restart_after_consumed_error():
+    """Regression: consuming a producer error used to kill the producer
+    thread for good, so before_first() + next() afterwards hung forever.
+    An error now ends the epoch, not the thread: after the raise, EOF is
+    sticky, and a reset restarts production."""
+
+    class FlakyFirstEpoch:
+        def __init__(self):
+            self.fail = True
+            self.i = 0
+
+        def before_first(self):
+            self.fail = False
+            self.i = 0
+
+        def next(self, reuse):
+            if self.fail:
+                raise ValueError("first epoch explodes")
+            if self.i >= 3:
+                return None
+            self.i += 1
+            return [self.i - 1]
+
+    it = ThreadedIter(FlakyFirstEpoch(), max_capacity=2)
+    with pytest.raises(ValueError, match="first epoch explodes"):
+        it.next()
+    assert it.next() is None  # post-error EOF is sticky, not a hang
+
+    result = []
+
+    def restart_and_drain():
+        it.before_first()
+        result.append(drain(it))
+
+    t = threading.Thread(target=restart_and_drain, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "restart after a consumed error hung"
+    assert result == [[0, 1, 2]]
+    it.destroy()
+
+
+def test_failed_epoch_returns_reuse_buffer_to_pool():
+    """Regression: a producer exception dropped the `reuse` buffer popped
+    from the recycle pool, so every failed epoch permanently shrank it."""
+
+    class FailOddEpochs:
+        def __init__(self):
+            self.epoch = 0
+            self.i = 0
+
+        def before_first(self):
+            self.epoch += 1
+            self.i = 0
+
+        def next(self, reuse):
+            if self.epoch % 2 == 1:
+                raise ValueError("flaky epoch")
+            if self.i >= 3:
+                return None
+            val = self.i
+            self.i += 1
+            if reuse is not None:
+                reuse[0] = val
+                return reuse
+            return [val]
+
+    it = ThreadedIter(FailOddEpochs(), max_capacity=2)
+    assert drain(it, recycle=True) == [0, 1, 2]  # epoch 0 fills the pool
+    with it._cond:
+        pool = len(it._free)
+    assert pool > 0
+    for _ in range(3):
+        it.before_first()  # odd epoch: producer raises on its first next()
+        with pytest.raises(ValueError, match="flaky epoch"):
+            while it.next() is not None:
+                pass
+        it.before_first()  # even epoch: clean, steady-state recycling
+        assert drain(it, recycle=True) == [0, 1, 2]
+    with it._cond:
+        assert len(it._free) == pool, "failed epochs shrank the recycle pool"
+    it.destroy()
+
+
+def test_eof_probe_does_not_leak_reuse_buffers():
+    """Regression: the producer's EOF call (next() returning None) popped a
+    buffer from the recycle pool and dropped it — one buffer leaked and
+    freshly re-allocated per epoch, defeating the recycling entirely."""
+
+    class CountingProducer:
+        def __init__(self):
+            self.i = 0
+            self.allocs = 0
+
+        def before_first(self):
+            self.i = 0
+
+        def next(self, reuse):
+            if self.i >= 2:
+                return None
+            val = self.i
+            self.i += 1
+            if reuse is None:
+                self.allocs += 1
+                reuse = [None]
+            reuse[0] = val
+            return reuse
+
+    producer = CountingProducer()
+    it = ThreadedIter(producer, max_capacity=1)
+    for _ in range(50):
+        assert drain(it, recycle=True) == [0, 1]
+        it.before_first()
+    # a handful of race-window allocations are fine; one-per-epoch is the bug
+    assert producer.allocs <= 10, (
+        f"{producer.allocs} fresh allocations over 50 epochs: the EOF "
+        "probe is leaking recycle-pool buffers")
+    it.destroy()
+
+
 def test_destroy_is_idempotent_and_fast():
     it = ThreadedIter(RangeProducer(10**9), max_capacity=2)
     it.next()

@@ -1,0 +1,86 @@
+"""Regression tests for thread-exception ferrying in the tracker backends.
+
+Before this PR (surfaced by the dmlclint lockset-thread-leak rule), every
+ssh/mpi/tpu-vm task thread used ``subprocess.check_call`` (or an unferried
+local def) directly as a ``threading.Thread`` target: a failing remote task
+raised inside ``Thread.run``, the traceback went to stderr, ``join()``
+returned success, and ``dmlc-submit`` exited 0 over a dead job.  Now the
+first task failure propagates out of ``submit()``.
+"""
+
+import subprocess
+
+import pytest
+
+from dmlc_core_tpu.tracker import mpi, ssh, tpu_vm
+from dmlc_core_tpu.tracker.opts import get_opts
+from dmlc_core_tpu.tracker.rendezvous import PSTracker
+
+
+@pytest.fixture
+def host_file(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("unreachable-host-a\nunreachable-host-b\n")
+    return str(hf)
+
+
+def _boom(cmd, *a, **kw):
+    raise subprocess.CalledProcessError(255, cmd)
+
+
+def test_ssh_submit_raises_on_task_failure(monkeypatch, host_file):
+    monkeypatch.setattr(ssh.subprocess, "check_call", _boom)
+    opts = get_opts(["--cluster", "ssh", "--num-workers", "2",
+                     "--host-file", host_file, "--host-ip", "127.0.0.1",
+                     "--", "true"])
+    with pytest.raises(subprocess.CalledProcessError):
+        ssh.submit(opts)
+
+
+def test_mpi_submit_raises_on_mpirun_failure(monkeypatch):
+    monkeypatch.setattr(mpi, "_detect_mpi_env_flag", lambda: "-x")
+    monkeypatch.setattr(mpi.subprocess, "check_call", _boom)
+    opts = get_opts(["--cluster", "mpi", "--num-workers", "1",
+                     "--host-ip", "127.0.0.1", "--", "true"])
+    with pytest.raises(subprocess.CalledProcessError):
+        mpi.submit(opts)
+
+
+def test_tpu_vm_submit_raises_on_worker_failure(monkeypatch, host_file):
+    monkeypatch.setattr(tpu_vm.subprocess, "check_call", _boom)
+    opts = get_opts(["--cluster", "tpu-vm", "--num-workers", "2",
+                     "--host-file", host_file, "--host-ip", "127.0.0.1",
+                     "--", "true"])
+    with pytest.raises(subprocess.CalledProcessError):
+        tpu_vm.submit(opts)
+
+
+def test_run_ferried_raises_first_error_after_all_join():
+    from dmlc_core_tpu.tracker.submit import run_ferried
+
+    ran = []
+
+    def ok(n):
+        ran.append(n)
+
+    def bad():
+        raise ValueError("task exploded")
+
+    with pytest.raises(ValueError, match="task exploded"):
+        run_ferried([("a", lambda: ok(1)), ("boom", bad),
+                     ("b", lambda: ok(2))])
+    # siblings of the failing task still ran to completion before the raise
+    assert sorted(ran) == [1, 2]
+    run_ferried([("c", lambda: ok(3))])  # no error: returns quietly
+    assert 3 in ran
+
+
+def test_ps_tracker_join_raises_on_scheduler_failure():
+    ps = PSTracker("127.0.0.1", cmd="exit 7")
+    with pytest.raises(RuntimeError, match="scheduler"):
+        ps.join()
+
+
+def test_ps_tracker_join_clean_on_success():
+    ps = PSTracker("127.0.0.1", cmd="true")
+    ps.join()  # must not raise
