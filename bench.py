@@ -186,9 +186,12 @@ def time_fit(model, bins, y, rounds, device, method):
         jax.block_until_ready(margin)  # compile + warm
         log_stage("warm fit done; timing")
         check_deadline("before timed fit")
+        from dmlc_core_tpu import telemetry
         start = time.perf_counter()
-        _, margin = fit(b, yy, w)
-        jax.block_until_ready(margin)
+        with telemetry.span("bench.timed_fit", device=device.platform,
+                            rounds=rounds, method=method):
+            _, margin = fit(b, yy, w)
+            jax.block_until_ready(margin)
         elapsed = time.perf_counter() - start
     log_stage(f"timed fit done: {elapsed:.3f}s")
     acc = float(((np.asarray(margin) > 0) == np.asarray(y)).mean())
@@ -224,8 +227,14 @@ def run_bench(force_cpu):
     import jax
     import numpy as np
 
+    from dmlc_core_tpu import telemetry
     from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
     from dmlc_core_tpu.ops.histogram import apply_bins, resolve_hist_method
+
+    # Per-stage attribution for the BENCH round: collect the whole child run
+    # (parser/threadediter/collective metric families land in the registry)
+    # and attach the registry snapshot to the emitted metric's detail below.
+    telemetry.enable()
 
     with tempfile.TemporaryDirectory() as tmpdir:
         pipeline_smoke(tmpdir)
@@ -344,6 +353,11 @@ def run_bench(force_cpu):
         result["detail"]["cpu_baseline_note"] = cpu_baseline_note
     if roofline is not None:
         result["detail"]["roofline"] = roofline
+    # per-stage attribution (ISSUE 2): the headline rows/sec now travels
+    # with the telemetry registry snapshot — parser rows/bytes, threadediter
+    # queue/stall counts, collective op latencies — one families dict, keyed
+    # exactly like docs/observability.md's catalog
+    result["detail"]["telemetry"] = telemetry.snapshot()["metrics"]
     print(JSON_TAG + json.dumps(result), flush=True)
 
 

@@ -33,6 +33,8 @@ CLI_EXEMPT = {
     "dmlc_core_tpu/tracker/launcher.py",
     "dmlc_core_tpu/io/__main__.py",
     "dmlc_core_tpu/analysis/driver.py",  # this CLI reports to stdout
+    "dmlc_core_tpu/telemetry/report.py",  # `telemetry report` CLI table
+    "dmlc_core_tpu/telemetry/__main__.py",
 }
 
 # the deep passes run on library code only; tests/examples get syntax checks
@@ -60,6 +62,10 @@ ALL_RULES = {
         "host, breaks tracing)"),
     "purity-impure-call": (
         "impure call inside traced code: random/time/open/print/input"),
+    "purity-telemetry-call": (
+        "telemetry helper (span/count/gauge/observe) inside traced code — "
+        "host-side only: it fires once at trace time and records nothing "
+        "(or one bogus sample) per compiled execution"),
     "resource-unclosed": (
         "open()/socket/TemporaryFile handle neither used as a context "
         "manager nor closed/returned/handed off in its function"),

@@ -34,6 +34,12 @@ Mechanics, per module:
      constants at trace time is legitimate and not flagged.
    - ``purity-impure-call``: ``random.*`` / ``np.random.*`` / ``time.*`` /
      ``open`` / ``print`` / ``input`` anywhere in traced code.
+   - ``purity-telemetry-call``: a :mod:`dmlc_core_tpu.telemetry` helper
+     (``span``/``count``/``gauge_set``/``gauge_add``/``observe``/
+     ``record_span``, or ``io.fs_metrics.note_request``) inside traced
+     code.  Telemetry is host-side only: under tracing the call fires once
+     at trace time — the compiled function then records nothing (or that
+     one stale sample) per execution, and the clock read is a host sync.
 """
 
 from __future__ import annotations
@@ -63,6 +69,9 @@ _CAST_BUILTINS = {"float", "int", "bool", "complex"}
 _STATIC_ANNOTATIONS = {"int", "bool", "str"}
 _IMPURE_ROOTS = {"random", "time"}
 _IMPURE_CALLS = {"open", "print", "input"}
+_TELEMETRY_MODULES = {"dmlc_core_tpu.telemetry", "dmlc_core_tpu.io.fs_metrics"}
+_TELEMETRY_HELPERS = {"span", "count", "gauge_set", "gauge_add", "observe",
+                      "record_span", "note_request", "request_start"}
 
 _FuncNode = ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
 
@@ -76,10 +85,12 @@ def run(ctx: FileContext) -> List[Finding]:
                      if mod == "numpy" or mod.startswith("numpy.")}
     random_aliases = {alias for alias, mod in ctx.module_aliases.items()
                       if mod.split(".")[0] in _IMPURE_ROOTS}
+    telemetry_names = _telemetry_names(ctx)
     findings: List[Finding] = []
     seen: Set[Tuple[str, int, str]] = set()
     for fn in traced:
-        for f in _check_traced(ctx, fn, numpy_aliases, random_aliases):
+        for f in _check_traced(ctx, fn, numpy_aliases, random_aliases,
+                               telemetry_names):
             dedup = (f.rule, f.lineno, f.symbol)
             if dedup not in seen:
                 seen.add(dedup)
@@ -227,6 +238,52 @@ def _np_call_on_param(node: ast.AST, nonstatic: Set[str],
     return None
 
 
+def _is_telemetry_module(path: str) -> bool:
+    return (path in _TELEMETRY_MODULES
+            or path.startswith("dmlc_core_tpu.telemetry."))
+
+
+def _telemetry_names(ctx: FileContext) -> Tuple[Set[str], Set[str]]:
+    """(module-alias names, directly-imported helper names) bound to the
+    telemetry package in this file.  ``module_aliases`` only sees plain
+    ``import X`` forms, but telemetry's documented idiom is
+    ``from dmlc_core_tpu import telemetry`` — so scan ImportFrom here."""
+    mods = {alias for alias, mod in ctx.module_aliases.items()
+            if _is_telemetry_module(mod)}
+    funcs: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ImportFrom) or node.module is None:
+            continue
+        for alias in node.names:
+            bound = alias.asname or alias.name
+            full = f"{node.module}.{alias.name}"
+            if _is_telemetry_module(full):
+                mods.add(bound)
+            elif _is_telemetry_module(node.module) \
+                    and alias.name in _TELEMETRY_HELPERS:
+                funcs.add(bound)
+    return mods, funcs
+
+
+def _telemetry_call(node: ast.AST,
+                    telemetry_names: Tuple[Set[str], Set[str]]
+                    ) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    mods, funcs = telemetry_names
+    root = name.split(".")[0]
+    hit = (root in mods or name in funcs
+           or name.startswith("dmlc_core_tpu.telemetry."))
+    if not hit:
+        return None
+    return (f"{name}() is host-side telemetry inside traced code — it runs "
+            "once at trace time, not per execution; meter outside the "
+            "jit/pallas boundary")
+
+
 def _impure_call(node: ast.AST, random_aliases: Set[str]) -> Optional[str]:
     if not isinstance(node, ast.Call):
         return None
@@ -245,7 +302,9 @@ def _impure_call(node: ast.AST, random_aliases: Set[str]) -> Optional[str]:
 
 
 def _check_traced(ctx: FileContext, fn: _FuncNode, numpy_aliases: Set[str],
-                  random_aliases: Set[str]) -> Iterable[Finding]:
+                  random_aliases: Set[str],
+                  telemetry_names: Tuple[Set[str], Set[str]]
+                  ) -> Iterable[Finding]:
     nonstatic = _nonstatic_params(fn)
     # host-branch: syncs inside if/while tests get the escalated rule
     branch_tests: Set[int] = set()
@@ -271,6 +330,10 @@ def _check_traced(ctx: FileContext, fn: _FuncNode, numpy_aliases: Set[str],
             np_msg = _np_call_on_param(node, nonstatic, numpy_aliases)
             if np_msg is not None:
                 yield ctx.finding("purity-np-call", node, np_msg)
+                continue
+            tel_msg = _telemetry_call(node, telemetry_names)
+            if tel_msg is not None:
+                yield ctx.finding("purity-telemetry-call", node, tel_msg)
                 continue
             impure = _impure_call(node, random_aliases)
             if impure is not None:

@@ -76,7 +76,7 @@ class MeshBatchLoader:
             raise ValueError(f"unknown batch form {form!r}")
         self._parser = parser
         self._host_iter = ThreadedIter(_EpochProducer(parser, factory),
-                                       max_capacity=prefetch)
+                                       max_capacity=prefetch, name="loader")
 
     def _shard(self, host_batch):
         import jax

@@ -31,7 +31,9 @@ from typing import Any, Optional
 
 import numpy as np
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.param import get_env
+from dmlc_core_tpu.telemetry import clock
 from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ, log_info
 
 __all__ = [
@@ -177,6 +179,26 @@ def _proc_slots(devices, nproc: int) -> np.ndarray:
 
 def _global_op(value: np.ndarray, op: str, root: Optional[int] = None,
                gather: bool = False) -> np.ndarray:
+    """Telemetry wrapper over :func:`_global_op_impl`: per-op latency
+    histogram, payload-byte counter, and a trace span — the labels collapse
+    root-moves to ``broadcast`` so the metric families stay small."""
+    if not telemetry.enabled():
+        return _global_op_impl(value, op, root, gather)
+    opname = "gather" if gather else ("broadcast" if root is not None else op)
+    value = np.asarray(value)
+    nbytes = int(value.nbytes)
+    start = clock.monotonic()
+    with telemetry.span(f"collective.{opname}", payload_bytes=nbytes):
+        out = _global_op_impl(value, op, root, gather)
+    telemetry.observe("dmlc_collective_op_seconds", clock.elapsed(start),
+                      op=opname)
+    telemetry.count("dmlc_collective_ops_total", op=opname)
+    telemetry.count("dmlc_collective_payload_bytes_total", nbytes, op=opname)
+    return out
+
+
+def _global_op_impl(value: np.ndarray, op: str, root: Optional[int] = None,
+                    gather: bool = False) -> np.ndarray:
     """Shared engine: stack per-process contributions on a leading axis,
     reduce (or gather) on device, return replicated result."""
     import jax

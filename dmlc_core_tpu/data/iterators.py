@@ -134,7 +134,8 @@ class DiskRowIter(RowBlockIter):
 
     def before_first(self) -> None:
         if self._iter is None:
-            self._iter = ThreadedIter(self._make_producer(), max_capacity=2)
+            self._iter = ThreadedIter(self._make_producer(), max_capacity=2,
+                                      name="row_iter")
         else:
             self._iter.before_first()
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.data.row_block import RowBlock, RowBlockContainer, concat_blocks
 from dmlc_core_tpu.io.input_split import InputSplit
 from dmlc_core_tpu.io.threadediter import ThreadedIter
@@ -102,6 +103,23 @@ class TextParserBase(ParserImpl):
         return None
 
     def parse_next_blocks(self) -> Optional[List[RowBlockContainer]]:
+        """One source chunk -> containers, with per-chunk telemetry (span +
+        ``dmlc_parser_{rows,bytes}_total``, labeled by parser class)."""
+        before = self._bytes_read
+        with telemetry.span("parser.parse_chunk",
+                            parser=type(self).__name__) as sp:
+            out = self._parse_next_blocks_impl()
+            if out is not None and telemetry.enabled():
+                nrows = sum(c.size for c in out)
+                nbytes = self._bytes_read - before
+                sp.set(rows=nrows, nbytes=nbytes)
+                telemetry.count("dmlc_parser_rows_total", nrows,
+                                parser=type(self).__name__)
+                telemetry.count("dmlc_parser_bytes_total", nbytes,
+                                parser=type(self).__name__)
+        return out
+
+    def _parse_next_blocks_impl(self) -> Optional[List[RowBlockContainer]]:
         # zero-copy fast path: a native split hands an (addr, len) view
         # over its resident chunk buffer and the native parser reads it in
         # place — no Python bytes between the two C++ stages.  Only taken
@@ -185,7 +203,8 @@ class ThreadedParser(Parser):
 
     def __init__(self, base: ParserImpl, max_capacity: int = 8):
         self._base = base
-        self._iter = ThreadedIter(_ParseProducer(base), max_capacity=max_capacity)
+        self._iter = ThreadedIter(_ParseProducer(base),
+                                  max_capacity=max_capacity, name="parse")
 
     def before_first(self) -> None:
         self._iter.before_first()

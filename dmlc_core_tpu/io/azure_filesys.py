@@ -27,6 +27,7 @@ import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional, Tuple
 
 from dmlc_core_tpu.io import filesys as fsys
+from dmlc_core_tpu.io import fs_metrics
 from dmlc_core_tpu.io.net_retry import request_with_retries
 from dmlc_core_tpu.io.stream import SeekStream, Stream
 from dmlc_core_tpu.param import get_env
@@ -107,8 +108,19 @@ class _AzureClient:
 
         # shared retry policy (net_retry); Put Block / Put Block List are
         # idempotent per block id, so replays are safe
+        def timed_perform():
+            # timed per attempt so dmlc_filesystem_request_seconds keeps
+            # its one-round-trip meaning (backoff between attempts already
+            # lands in dmlc_net_retry_backoff_seconds_total)
+            t0 = fs_metrics.request_start()
+            attempt = perform()
+            fs_metrics.note_request("azure", method, t0,
+                                    nread=len(attempt[2]),
+                                    nwritten=len(body))
+            return attempt
+
         status, rheaders, data = request_with_retries(
-            perform, ok, f"{method} {self.host}{url}")
+            timed_perform, ok, f"{method} {self.host}{url}")
         if status not in ok:
             log_fatal(f"azure error {status} on {method} {url}: "
                       f"{data[:500]!r}")

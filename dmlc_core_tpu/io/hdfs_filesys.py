@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import List
 
 from dmlc_core_tpu.io import filesys as fsys
+from dmlc_core_tpu.io import fs_metrics
 from dmlc_core_tpu.io.stream import SeekStream, Stream
 from dmlc_core_tpu.registry import Registry
 from dmlc_core_tpu.utils.logging import CHECK, log_fatal
@@ -41,11 +42,16 @@ class _ArrowStream(SeekStream):
         self._writable = writable
 
     def read(self, nbytes: int) -> bytes:
-        return self._f.read(nbytes)
+        t0 = fs_metrics.request_start()
+        data = self._f.read(nbytes)
+        fs_metrics.note_request("hdfs", "read", t0, nread=len(data))
+        return data
 
     def write(self, data: bytes) -> None:
         CHECK(self._writable, "stream opened read-only")
+        t0 = fs_metrics.request_start()
         self._f.write(data)
+        fs_metrics.note_request("hdfs", "write", t0, nwritten=len(data))
 
     def seek(self, pos: int) -> None:
         self._f.seek(pos)

@@ -13,6 +13,7 @@ import urllib.parse
 from typing import List
 
 from dmlc_core_tpu.io import filesys as fsys
+from dmlc_core_tpu.io import fs_metrics
 from dmlc_core_tpu.io.stream import SeekStream, Stream
 from dmlc_core_tpu.registry import Registry
 from dmlc_core_tpu.utils.logging import CHECK, log_fatal
@@ -36,6 +37,7 @@ class _HTTPReadStream(SeekStream):
     def _fetch(self, start: int, length: int) -> bytes:
         conn = (http.client.HTTPSConnection if self._secure
                 else http.client.HTTPConnection)(self._host, timeout=60)
+        t0 = fs_metrics.request_start()
         try:
             headers = {}
             if self._ranges:
@@ -43,6 +45,7 @@ class _HTTPReadStream(SeekStream):
             conn.request("GET", self._path, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
+            fs_metrics.note_request("http", "GET", t0, nread=len(data))
             CHECK(resp.status in (200, 206),
                   f"http error {resp.status} for {self._path}")
             if resp.status == 200 and self._ranges:

@@ -839,7 +839,8 @@ class ThreadedInputSplit(InputSplit):
 
     def __init__(self, base: InputSplitBase):
         self._base = base
-        self._iter: ThreadedIter = ThreadedIter(_ChunkProducer(base), max_capacity=2)
+        self._iter: ThreadedIter = ThreadedIter(_ChunkProducer(base), max_capacity=2,
+                                                name="split_chunk")
         self._cursor = ChunkCursor()
 
     def before_first(self) -> None:
@@ -856,7 +857,8 @@ class ThreadedInputSplit(InputSplit):
         # pause the producer, reshard, restart (reference threaded_input_split.h:55-60)
         self._iter.destroy()
         self._base.reset_partition(part_index, num_parts)
-        self._iter = ThreadedIter(_ChunkProducer(self._base), max_capacity=2)
+        self._iter = ThreadedIter(_ChunkProducer(self._base), max_capacity=2,
+                                  name="split_chunk")
         self._cursor = ChunkCursor()
 
     def next_chunk(self) -> Optional[bytes]:
@@ -881,7 +883,8 @@ class CachedInputSplit(InputSplit):
         self._cursor = ChunkCursor()
         self._cache_fo = open(cache_file, "wb")
         self._preproc = True
-        self._iter = ThreadedIter(self._make_preproc_producer(), max_capacity=2)
+        self._iter = ThreadedIter(self._make_preproc_producer(), max_capacity=2,
+                                  name="split_preproc")
 
     def _make_preproc_producer(self):
         parent = self
@@ -930,7 +933,8 @@ class CachedInputSplit(InputSplit):
         self._cache_fo.close()
         self._base.close()
         self._preproc = False
-        self._iter = ThreadedIter(self._make_cache_producer(), max_capacity=2)
+        self._iter = ThreadedIter(self._make_cache_producer(), max_capacity=2,
+                                  name="split_cache")
 
     def before_first(self) -> None:
         if self._preproc:
@@ -964,7 +968,8 @@ class CachedInputSplit(InputSplit):
             self._cache_fo.close()
             self._base.close()
             self._preproc = False
-            self._iter = ThreadedIter(self._make_cache_producer(), max_capacity=2)
+            self._iter = ThreadedIter(self._make_cache_producer(), max_capacity=2,
+                                      name="split_cache")
             # leave the new iterator at end-of-epoch state: consume nothing; the
             # caller's before_first() rewinds it.
             while self._iter.next() is not None:

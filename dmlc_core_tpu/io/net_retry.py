@@ -16,6 +16,7 @@ import ssl
 import time
 from typing import Callable, Dict, Tuple
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.param import get_env
 
 __all__ = ["RETRYABLE_EXC", "RETRYABLE_STATUS", "request_with_retries"]
@@ -52,9 +53,12 @@ def request_with_retries(perform: Callable[[], Response],
             status, headers, data = perform()
         except RETRYABLE_EXC as exc:
             if attempt >= max_retry:
+                telemetry.count("dmlc_net_retry_exhausted_total",
+                                status_class="transport")
                 raise
             logger.warning("re-establishing connection (%s, retry %d): %s",
                            describe, attempt + 1, exc)
+            _note_retry("transport", delay)
             time.sleep(delay)
             delay *= 2
             continue
@@ -62,8 +66,27 @@ def request_with_retries(perform: Callable[[], Response],
                 and attempt < max_retry:
             logger.warning("%s returned %d; retry %d", describe, status,
                            attempt + 1)
+            _note_retry(_status_class(status), delay)
             time.sleep(delay)
             delay *= 2
             continue
+        if status in RETRYABLE_STATUS and attempt >= max_retry:
+            telemetry.count("dmlc_net_retry_exhausted_total",
+                            status_class=_status_class(status))
         return status, headers, data
     raise AssertionError("unreachable")
+
+
+def _status_class(status: int) -> str:
+    """Coarse status bucket for metric labels ("4xx"/"5xx")."""
+    return f"{status // 100}xx"
+
+
+def _note_retry(status_class: str, backoff_s: float) -> None:
+    """One retry decision -> the dmlc_net_retry_* metric family."""
+    if not telemetry.enabled():
+        return
+    telemetry.count("dmlc_net_retry_retries_total",
+                    status_class=status_class)
+    telemetry.count("dmlc_net_retry_backoff_seconds_total", backoff_s,
+                    status_class=status_class)

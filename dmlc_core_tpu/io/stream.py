@@ -18,6 +18,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ
 
 __all__ = [
@@ -225,7 +226,10 @@ def create_stream(uri: str, mode: str, allow_null: bool = False) -> Optional[Str
     uri_obj = filesys.URI(uri)
     fs = filesys.get_filesystem(uri_obj)
     try:
-        return fs.open(uri_obj, mode)
+        with telemetry.span("io.stream.open",
+                            protocol=uri_obj.protocol or "file://",
+                            mode=mode):
+            return fs.open(uri_obj, mode)
     except (OSError, IOError):
         if allow_null:
             return None
@@ -239,7 +243,10 @@ def create_stream_for_read(uri: str, allow_null: bool = False) -> Optional[SeekS
     uri_obj = filesys.URI(uri)
     fs = filesys.get_filesystem(uri_obj)
     try:
-        return fs.open_for_read(uri_obj)
+        with telemetry.span("io.stream.open",
+                            protocol=uri_obj.protocol or "file://",
+                            mode="r"):
+            return fs.open_for_read(uri_obj)
     except (OSError, IOError):
         if allow_null:
             return None

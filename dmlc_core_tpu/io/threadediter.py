@@ -26,9 +26,14 @@ same pattern (double-buffered ``device_put`` against a mesh) lives in
 
 from __future__ import annotations
 
+import logging
 import threading
 from collections import deque
 from typing import Any, Callable, Generic, Iterator, Optional, TypeVar
+
+from dmlc_core_tpu import telemetry
+
+logger = logging.getLogger("dmlc_core_tpu.io")
 
 T = TypeVar("T")
 
@@ -57,10 +62,22 @@ class IteratorProducer:
 
 
 class ThreadedIter(Generic[T]):
-    """Single-producer bounded-queue prefetch iterator."""
+    """Single-producer bounded-queue prefetch iterator.
 
-    def __init__(self, producer: Any = None, max_capacity: int = 8):
+    Observability: :meth:`qsize` reports current queue occupancy;
+    ``producer_stalls`` / ``consumer_stalls`` count wait *episodes* (a
+    producer blocked on a full queue / a consumer blocked on an empty one —
+    each stall names the side that is the bottleneck); the optional
+    ``on_producer_stall`` / ``on_consumer_stall`` hooks fire once per
+    episode (called under the iterator lock: keep them cheap and never
+    call back into the iterator).  With telemetry enabled the same signals
+    feed the ``dmlc_threadediter_*`` metric families, labeled by ``name``.
+    """
+
+    def __init__(self, producer: Any = None, max_capacity: int = 8,
+                 name: str = "threadediter"):
         self._cap = max(1, int(max_capacity))
+        self._name = name
         self._cond = threading.Condition()
         self._queue: deque = deque()      # (generation, item-or-_END)
         self._free: deque = deque()       # recycled buffers
@@ -69,13 +86,68 @@ class ThreadedIter(Generic[T]):
         self._error: Optional[BaseException] = None
         self._producer = None
         self._thread: Optional[threading.Thread] = None
+        self.producer_stalls = 0
+        self.consumer_stalls = 0
+        self.on_producer_stall: Optional[Callable[[], None]] = None
+        self.on_consumer_stall: Optional[Callable[[], None]] = None
         if producer is not None:
             self.init(producer)
 
     @classmethod
-    def from_factory(cls, factory: Callable[[], Any], max_capacity: int = 8) -> "ThreadedIter":
+    def from_factory(cls, factory: Callable[[], Any], max_capacity: int = 8,
+                     name: str = "threadediter") -> "ThreadedIter":
         """ThreadedIter over ``iter(factory())`` per epoch."""
-        return cls(IteratorProducer(factory), max_capacity=max_capacity)
+        return cls(IteratorProducer(factory), max_capacity=max_capacity,
+                   name=name)
+
+    # -- observability --------------------------------------------------------
+    def qsize(self) -> int:
+        """Real items of the current generation queued right now (end-of-
+        epoch/error sentinels and stale-generation leftovers excluded)."""
+        with self._cond:
+            return self._qsize_locked()
+
+    def _qsize_locked(self) -> int:
+        return sum(1 for gen, item in self._queue
+                   if gen == self._gen and item is not _END)
+
+    def _note_depth_locked(self) -> None:
+        try:
+            if telemetry.enabled():
+                telemetry.gauge_set("dmlc_threadediter_queue_depth",
+                                    self._qsize_locked(), name=self._name)
+        except Exception:
+            # observability must never kill the producer thread (a dead
+            # producer with no _error/_END posted hangs next() forever)
+            logger.exception("queue-depth telemetry failed")
+
+    def _note_producer_stall_locked(self) -> None:
+        self.producer_stalls += 1
+        # counter first: a raising user hook must not desync the exported
+        # count from the attribute just incremented
+        try:
+            telemetry.count("dmlc_threadediter_producer_stalls_total",
+                            name=self._name)
+        except Exception:
+            logger.exception("producer-stall telemetry failed")
+        try:
+            if self.on_producer_stall is not None:
+                self.on_producer_stall()
+        except Exception:
+            logger.exception("producer-stall hook failed")
+
+    def _note_consumer_stall_locked(self) -> None:
+        self.consumer_stalls += 1
+        try:
+            telemetry.count("dmlc_threadediter_consumer_stalls_total",
+                            name=self._name)
+        except Exception:
+            logger.exception("consumer-stall telemetry failed")
+        try:
+            if self.on_consumer_stall is not None:
+                self.on_consumer_stall()
+        except Exception:
+            logger.exception("consumer-stall hook failed")
 
     def init(self, producer: Any) -> None:
         assert self._thread is None, "ThreadedIter already initialized"
@@ -116,6 +188,10 @@ class ThreadedIter(Generic[T]):
         """Produce items for ``cur_gen`` until EOF/reset. None means destroyed."""
         while True:
             with self._cond:
+                if (len(self._queue) >= self._cap and not self._destroyed
+                        and self._gen == cur_gen):
+                    # queue full: the consumer is the bottleneck right now
+                    self._note_producer_stall_locked()
                 while (len(self._queue) >= self._cap and not self._destroyed
                        and self._gen == cur_gen):
                     self._cond.wait()
@@ -125,7 +201,8 @@ class ThreadedIter(Generic[T]):
                     return True  # reset requested mid-epoch
                 reuse = self._free.popleft() if self._free else None
             try:
-                item = self._producer.next(reuse)
+                with telemetry.span("threadediter.produce", name=self._name):
+                    item = self._producer.next(reuse)
             except BaseException as exc:  # noqa: BLE001
                 if reuse is not None:
                     # the buffer was never handed to the consumer; without
@@ -147,6 +224,7 @@ class ThreadedIter(Generic[T]):
                         self._free.append(reuse)
                     return True
                 self._queue.append((cur_gen, _END if item is None else item))
+                self._note_depth_locked()
                 self._cond.notify_all()
                 if item is None:
                     # EOF probe: the popped reuse buffer was never consumed
@@ -169,6 +247,7 @@ class ThreadedIter(Generic[T]):
     def next(self) -> Optional[T]:
         """Next item, or None at end of the current epoch (reference Next)."""
         with self._cond:
+            stalled = False
             while True:
                 if self._destroyed:
                     return None
@@ -190,8 +269,13 @@ class ThreadedIter(Generic[T]):
                             raise err
                         return None  # leave _END queued: epoch stays "ended"
                     self._queue.popleft()
+                    self._note_depth_locked()
                     self._cond.notify_all()
                     return item
+                if not stalled:
+                    # empty queue: the producer is the bottleneck right now
+                    stalled = True
+                    self._note_consumer_stall_locked()
                 self._cond.wait()
 
     def recycle(self, item: T) -> None:
@@ -214,6 +298,7 @@ class ThreadedIter(Generic[T]):
                 _, item = self._queue.popleft()
                 if item is not _END:
                     self._free.append(item)
+            self._note_depth_locked()
             self._cond.notify_all()
 
     def destroy(self) -> None:

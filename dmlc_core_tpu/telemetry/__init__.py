@@ -1,0 +1,209 @@
+"""Unified metrics + span tracing for the io/tracker/collective hot paths.
+
+The reference's observability was ``GetTime()`` plus inline "N MB, X MB/sec"
+prints (src/data/basic_row_iter.h:70-75).  This package is the structured
+replacement: a process-wide metrics registry (counters / gauges / fixed-
+bucket histograms with labels), a span tracer exporting Chrome-trace JSON
+(chrome://tracing / Perfetto) and JSONL, and Prometheus-text / JSON-snapshot
+exporters with an atexit flush.  The per-subsystem metric catalog lives in
+``docs/observability.md``.
+
+Usage (instrumentation sites)::
+
+    from dmlc_core_tpu import telemetry
+
+    telemetry.count("dmlc_parser_rows_total", n, format="libsvm")
+    telemetry.gauge_set("dmlc_threadediter_queue_depth", depth)
+    telemetry.observe("dmlc_filesystem_request_seconds", dt, fs="s3")
+    with telemetry.span("parser.parse_chunk", nbytes=len(chunk)):
+        ...
+
+**Disabled is the default and costs (almost) nothing**: every helper checks
+the module-level ``_enabled`` flag before touching the registry, allocating,
+or reading a clock; :func:`span` returns a shared no-op context manager.
+Enable explicitly via :func:`enable`, or by environment:
+
+- ``DMLC_TELEMETRY=1``     — enable collection;
+- ``DMLC_TELEMETRY_DIR=d`` — enable collection AND flush every export form
+  into ``d`` at interpreter exit (rank/pid-keyed filenames, aggregatable
+  across ranks with ``python -m dmlc_core_tpu.telemetry report d``).
+
+Telemetry helpers are **host-side only**: calling them inside jit/pallas-
+traced code would bake one trace-time measurement into the compiled function
+(at best) — the analysis purity pass flags exactly that
+(``purity-telemetry-call``, see docs/analysis.md).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+from dmlc_core_tpu.telemetry import clock  # noqa: F401  (re-export)
+from dmlc_core_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter, Gauge,
+                                              Histogram, MetricRegistry)
+from dmlc_core_tpu.telemetry.spans import Span, SpanTracer
+
+__all__ = [
+    "enabled", "enable", "disable", "reset",
+    "count", "gauge_set", "gauge_add", "observe", "span", "record_span",
+    "get_registry", "get_tracer",
+    "snapshot", "prometheus_text", "flush",
+    "Counter", "Gauge", "Histogram", "MetricRegistry", "SpanTracer", "Span",
+    "DEFAULT_BUCKETS", "clock",
+]
+
+_enabled = False
+_flush_dir: Optional[str] = None
+_registry = MetricRegistry()
+_tracer = SpanTracer()
+_lock = threading.Lock()
+_atexit_registered = False
+
+
+class _NullSpan:
+    """Shared no-op span: the whole disabled-mode cost of ``with span(...)``."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+    def set(self, **attrs: Any) -> None:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+# -- switch ------------------------------------------------------------------
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(flush_dir: Optional[str] = None) -> None:
+    """Turn collection on; with ``flush_dir``, also flush at interpreter exit."""
+    global _enabled, _flush_dir, _atexit_registered
+    with _lock:
+        _enabled = True
+        if flush_dir:
+            _flush_dir = flush_dir
+            if not _atexit_registered:
+                atexit.register(_atexit_flush)
+                _atexit_registered = True
+
+
+def disable() -> None:
+    global _enabled
+    with _lock:
+        _enabled = False
+
+
+def reset() -> None:
+    """Drop all collected state (metrics + spans).  Collection stays as-is."""
+    _registry.reset()
+    _tracer.reset()
+
+
+def _atexit_flush() -> None:
+    if _enabled and _flush_dir:
+        try:
+            flush(_flush_dir)
+        except Exception:
+            pass  # nothing at interpreter exit may turn into a traceback
+
+
+# -- hot-path helpers (flag checked before anything else) --------------------
+
+def count(name: str, n: float = 1, /, **labels: Any) -> None:
+    """Increment counter ``name`` (no-op when disabled)."""
+    if not _enabled:
+        return
+    _registry.counter(name, **labels).inc(n)
+
+
+def gauge_set(name: str, value: float, /, **labels: Any) -> None:
+    if not _enabled:
+        return
+    _registry.gauge(name, **labels).set(value)
+
+
+def gauge_add(name: str, delta: float, /, **labels: Any) -> None:
+    if not _enabled:
+        return
+    _registry.gauge(name, **labels).inc(delta)
+
+
+def observe(name: str, value: float, /, *,
+            buckets: Optional[Iterable[float]] = None, **labels: Any) -> None:
+    """Record ``value`` into histogram ``name`` (no-op when disabled)."""
+    if not _enabled:
+        return
+    _registry.histogram(name, buckets=buckets, **labels).observe(value)
+
+
+def span(name: str, /, **attrs: Any):
+    """Context manager tracing one span (shared no-op when disabled)."""
+    if not _enabled:
+        return _NULL_SPAN
+    return _tracer.span(name, **attrs)
+
+
+def record_span(name: str, start: float, end: float, /, **attrs: Any) -> None:
+    """Record a span bracketed by two :func:`clock.monotonic` readings."""
+    if not _enabled:
+        return
+    _tracer.record_complete(name, start, end, **attrs)
+
+
+# -- access / export ---------------------------------------------------------
+
+def get_registry() -> MetricRegistry:
+    return _registry
+
+
+def get_tracer() -> SpanTracer:
+    return _tracer
+
+
+def snapshot() -> Dict[str, Any]:
+    from dmlc_core_tpu.telemetry import export
+
+    return export.json_snapshot(_registry, _tracer)
+
+
+def prometheus_text() -> str:
+    from dmlc_core_tpu.telemetry import export
+
+    return export.prometheus_text(_registry)
+
+
+def flush(dirpath: Optional[str] = None) -> Dict[str, str]:
+    """Write snapshot/prom/trace/events into ``dirpath`` (or the env dir)."""
+    from dmlc_core_tpu.telemetry import export
+
+    target = dirpath or _flush_dir or os.environ.get("DMLC_TELEMETRY_DIR")
+    if not target:
+        raise ValueError("no telemetry directory: pass dirpath or set "
+                         "DMLC_TELEMETRY_DIR")
+    return export.flush(target, _registry, _tracer)
+
+
+# -- env-driven bring-up -----------------------------------------------------
+
+def _init_from_env() -> None:
+    env_dir = os.environ.get("DMLC_TELEMETRY_DIR", "").strip()
+    flag = os.environ.get("DMLC_TELEMETRY", "").strip().lower()
+    if env_dir:
+        enable(env_dir)
+    elif flag not in ("", "0", "false", "off"):
+        enable()
+
+
+_init_from_env()

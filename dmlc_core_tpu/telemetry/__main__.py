@@ -1,0 +1,32 @@
+"""CLI: ``python -m dmlc_core_tpu.telemetry report <dir> [--json]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from dmlc_core_tpu.telemetry import report
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m dmlc_core_tpu.telemetry",
+        description="telemetry snapshot tooling")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser(
+        "report", help="aggregate rank snapshots from a DMLC_TELEMETRY_DIR")
+    rep.add_argument("dir", help="directory holding metrics-*.json snapshots")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the merged result as JSON instead of a table")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "report":
+        return report.main(args.dir, as_json=args.json)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

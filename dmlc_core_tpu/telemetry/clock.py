@@ -1,0 +1,41 @@
+"""The one monotonic clock for host-side metering.
+
+Every hand-rolled ``time.perf_counter()`` idiom in utils/ (timer.get_time,
+profiler.ThroughputMeter, profiler.device_timer) now routes through here, so
+"what clock does telemetry use" has exactly one answer: ``perf_counter``,
+monotonic, sub-microsecond resolution, meaningless across processes.
+
+Span timestamps additionally need a per-process epoch so multiple ranks'
+traces can be laid side by side in Perfetto: :func:`trace_time_us` is
+microseconds since an arbitrary-but-fixed process start.  Wall-clock
+(``time.time``) is only used to stamp exported snapshots, never to measure.
+"""
+
+from __future__ import annotations
+
+import time
+
+__all__ = ["monotonic", "elapsed", "trace_time_us", "to_trace_us"]
+
+_PROCESS_EPOCH = time.perf_counter()
+
+
+def monotonic() -> float:
+    """Seconds on the process-wide monotonic clock."""
+    return time.perf_counter()
+
+
+def elapsed(start: float) -> float:
+    """Seconds since ``start`` (a previous :func:`monotonic` reading)."""
+    return time.perf_counter() - start
+
+
+def trace_time_us() -> float:
+    """Microseconds since process start — the Chrome-trace ``ts`` domain."""
+    return (time.perf_counter() - _PROCESS_EPOCH) * 1e6
+
+
+def to_trace_us(t: float) -> float:
+    """Convert a :func:`monotonic` reading into the ``ts`` domain (for spans
+    whose begin time was captured before the span was named)."""
+    return (t - _PROCESS_EPOCH) * 1e6

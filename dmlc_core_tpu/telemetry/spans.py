@@ -1,0 +1,130 @@
+"""Span tracer: begin/end events per thread, Chrome-trace + JSONL export.
+
+Spans are *complete* events (Chrome trace ``"ph": "X"``): one record per
+span carrying its start timestamp and duration, appended at span end — no
+begin/end pairing pass is needed at export time and a crashed span simply
+never appears.  Timestamps are microseconds on the process-monotonic clock
+(:func:`dmlc_core_tpu.telemetry.clock.trace_time_us`), so traces from
+several ranks laid side by side in Perfetto share a plausible-if-not-
+synchronized time axis.
+
+The buffer is bounded (``max_events``, default 200k): past the cap new
+spans are counted as dropped rather than grown without limit — a telemetry
+subsystem that OOMs the pipeline it observes would be worse than none.
+
+The enabled/disabled fast path lives in the package ``__init__``; this
+module always records when called.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+from dmlc_core_tpu.telemetry import clock
+
+__all__ = ["SpanTracer", "Span"]
+
+
+class Span:
+    """Context manager recording one complete event on exit."""
+
+    __slots__ = ("_tracer", "_name", "_attrs", "_start")
+
+    def __init__(self, tracer: "SpanTracer", name: str,
+                 attrs: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self._name = name
+        self._attrs = attrs
+        self._start = 0.0
+
+    def __enter__(self) -> "Span":
+        self._start = clock.trace_time_us()
+        return self
+
+    def set(self, **attrs: Any) -> None:
+        """Attach attributes discovered mid-span (e.g. bytes handled)."""
+        if self._attrs is None:
+            self._attrs = {}
+        self._attrs.update(attrs)
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        end = clock.trace_time_us()
+        if exc_type is not None:
+            self.set(error=exc_type.__name__)
+        self._tracer.record(self._name, self._start, end - self._start,
+                            self._attrs)
+
+
+class SpanTracer:
+    """Process-wide span sink with per-thread identity."""
+
+    def __init__(self, max_events: int = 200_000):
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._thread_meta: Dict[int, str] = {}
+        self._max = max_events
+        self.dropped = 0
+
+    def span(self, name: str, /, **attrs: Any) -> Span:
+        return Span(self, name, attrs or None)
+
+    def record(self, name: str, start_us: float, dur_us: float,
+               attrs: Optional[Dict[str, Any]] = None) -> None:
+        """Append one complete event (``ph: X``)."""
+        tid = threading.get_ident()
+        event: Dict[str, Any] = {
+            "name": name, "ph": "X", "ts": round(start_us, 3),
+            "dur": round(max(dur_us, 0.0), 3),
+            "pid": os.getpid(), "tid": tid,
+        }
+        if attrs:
+            event["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            if len(self._events) >= self._max:
+                self.dropped += 1
+                return
+            if tid not in self._thread_meta:
+                self._thread_meta[tid] = threading.current_thread().name
+            self._events.append(event)
+
+    def record_complete(self, name: str, start: float, end: float,
+                        /, **attrs: Any) -> None:
+        """Record a span bracketed by explicit :func:`clock.monotonic`
+        readings — for phases whose begin predates knowing their name
+        (e.g. tracker rendezvous: connect time is only attributable once
+        the rank is assigned)."""
+        self.record(name, clock.to_trace_us(start),
+                    (end - start) * 1e6, attrs or None)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """chrome://tracing / Perfetto loadable JSON object."""
+        with self._lock:
+            events = list(self._events)
+            meta = [{"name": "thread_name", "ph": "M", "pid": os.getpid(),
+                     "tid": tid, "args": {"name": tname}}
+                    for tid, tname in sorted(self._thread_meta.items())]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def jsonl(self) -> Iterator[str]:
+        """One JSON object per line — the appendable event-log form."""
+        for event in self.events():
+            yield json.dumps(event, sort_keys=True)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._thread_meta.clear()
+            self.dropped = 0
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)

@@ -33,6 +33,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.telemetry import clock
+
 logger = logging.getLogger("dmlc_core_tpu.tracker")
 
 MAGIC = 0xFF99
@@ -79,6 +82,7 @@ class WorkerEntry:
     side; message sequence documented on each method)."""
 
     def __init__(self, sock: socket.socket, addr):
+        connect_start = clock.monotonic()
         self.sock = FramedSocket(sock)
         self.host = _resolve_ip(addr[0])
         magic = self.sock.recvint()
@@ -89,6 +93,10 @@ class WorkerEntry:
         self.world_size = self.sock.recvint()
         self.jobid = self.sock.recvstr()
         self.cmd = self.sock.recvstr()
+        # connect-phase bracket, attributed to a rank once one is assigned
+        # (assign_rank emits the span) — the per-rank rendezvous timeline is
+        # connect -> assign -> barrier in the exported trace
+        self.connect_span = (connect_start, clock.monotonic())
         # inbound links this worker still expects peers to dial (it stays in
         # the tracker's accept registry until this reaches zero)
         self.pending_accepts = 0
@@ -179,10 +187,21 @@ class WorkerEntry:
     def assign_rank(self, rank: int,
                     accept_registry: Dict[int, "WorkerEntry"],
                     tree_map, parent_map, ring_map) -> List[int]:
+        telemetry.record_span("rendezvous.connect", *self.connect_span,
+                              rank=rank, host=self.host, cmd=self.cmd)
+        assign_start = clock.monotonic()
         ring_prev, ring_next = ring_map[rank]
         links = self.send_topology(rank, len(tree_map), tree_map[rank],
                                    parent_map[rank], ring_prev, ring_next)
-        return self.broker_links(links, accept_registry)
+        out = self.broker_links(links, accept_registry)
+        telemetry.record_span("rendezvous.assign", assign_start,
+                              clock.monotonic(), rank=rank,
+                              links=len(links))
+        if telemetry.enabled():
+            telemetry.observe("dmlc_rendezvous_assign_seconds",
+                              clock.elapsed(assign_start))
+            telemetry.count("dmlc_rendezvous_workers_total", cmd=self.cmd)
+        return out
 
 
 def bind_free_port(host: str, port: int = 9091,
@@ -288,6 +307,7 @@ class RabitTracker:
         pending: List[WorkerEntry] = []
         tree_map = None
         todo_nodes: List[int] = []
+        barrier_start: Optional[float] = None
         while len(shutdown) != n:
             fd, addr = self.sock.accept()
             try:
@@ -305,6 +325,9 @@ class RabitTracker:
                 logger.debug("shutdown signal from %d", s.rank)
                 continue
             assert s.cmd in ("start", "recover"), s.cmd
+            if barrier_start is None:
+                # barrier = first worker knocking until all n are started
+                barrier_start = s.connect_span[0]
             if tree_map is None:
                 assert s.cmd == "start"
                 if s.world_size > 0:
@@ -335,6 +358,12 @@ class RabitTracker:
                 if not todo_nodes:
                     logger.info("@tracker all of %d nodes started", n)
                     self.start_time = time.time()
+                    if barrier_start is not None:
+                        telemetry.record_span("rendezvous.barrier",
+                                              barrier_start, clock.monotonic(),
+                                              world=n)
+                        telemetry.observe("dmlc_rendezvous_barrier_seconds",
+                                          clock.elapsed(barrier_start))
             else:
                 s.assign_rank(rank, accept_registry, tree_map, parent_map,
                               ring_map)

@@ -15,16 +15,24 @@ equivalents here:
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Any, Callable, Iterator, Optional, Tuple
 
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.telemetry import clock
 from dmlc_core_tpu.utils.logging import log_info
 
 __all__ = ["ThroughputMeter", "trace", "annotate", "device_timer"]
 
 
 class ThroughputMeter:
-    """Incremental byte/row throughput with periodic logging."""
+    """Incremental byte/row throughput with periodic logging.
+
+    A thin facade over the telemetry registry: the rolling state here only
+    feeds :meth:`summary` / the periodic log line; when telemetry is enabled
+    every :meth:`add` also lands in the ``dmlc_pipeline_bytes_total`` /
+    ``dmlc_pipeline_rows_total`` counters (labeled ``meter=<name>``), so
+    there is exactly one metering path and exporters see what the log says.
+    """
 
     def __init__(self, name: str = "pipeline", log_every_bytes: int = 10 << 20):
         self.name = name
@@ -32,7 +40,7 @@ class ThroughputMeter:
         self.reset()
 
     def reset(self) -> None:
-        self._start = time.perf_counter()
+        self._start = clock.monotonic()
         self._bytes = 0
         self._rows = 0
         self._next_log = self._log_every
@@ -40,6 +48,13 @@ class ThroughputMeter:
     def add(self, nbytes: int, nrows: int = 0) -> None:
         self._bytes += nbytes
         self._rows += nrows
+        if telemetry.enabled():
+            if nbytes:
+                telemetry.count("dmlc_pipeline_bytes_total", nbytes,
+                                meter=self.name)
+            if nrows:
+                telemetry.count("dmlc_pipeline_rows_total", nrows,
+                                meter=self.name)
         if self._bytes >= self._next_log:
             self._next_log += self._log_every
             log_info(f"{self.name}: {self.mb:.0f} MB read, "
@@ -47,7 +62,7 @@ class ThroughputMeter:
 
     @property
     def elapsed(self) -> float:
-        return max(time.perf_counter() - self._start, 1e-9)
+        return max(clock.elapsed(self._start), 1e-9)
 
     @property
     def mb(self) -> float:
@@ -96,8 +111,8 @@ def device_timer(fn: Callable, *args: Any, iters: int = 1,
     out = None
     for _ in range(max(warmup, 0)):
         out = jax.block_until_ready(fn(*args))
-    start = time.perf_counter()
+    start = clock.monotonic()
     for _ in range(iters):
         out = fn(*args)
     out = jax.block_until_ready(out)
-    return out, (time.perf_counter() - start) / max(iters, 1)
+    return out, clock.elapsed(start) / max(iters, 1)

@@ -1,26 +1,31 @@
 """Wall-clock timer (reference: include/dmlc/timer.h:27-46).
 
+The actual clock lives in :mod:`dmlc_core_tpu.telemetry.clock` — the single
+monotonic-clock helper every meter in this package shares (this module used
+to hand-roll ``time.perf_counter`` alongside profiler.py; now there is one
+metering path).
+
 On TPU, timing device work additionally requires ``jax.block_until_ready`` —
 see :func:`device_time` — because dispatch is asynchronous.
 """
 
 from __future__ import annotations
 
-import time
+from dmlc_core_tpu.telemetry import clock
 
 __all__ = ["get_time", "device_time"]
 
 
 def get_time() -> float:
-    """Seconds since epoch at the highest available resolution."""
-    return time.perf_counter()
+    """Seconds on a monotonic clock at the highest available resolution."""
+    return clock.monotonic()
 
 
 def device_time(fn, *args, **kwargs):
     """Run ``fn`` and block on its jax outputs; return (result, elapsed_seconds)."""
     import jax
 
-    start = time.perf_counter()
+    start = clock.monotonic()
     out = fn(*args, **kwargs)
     out = jax.block_until_ready(out)
-    return out, time.perf_counter() - start
+    return out, clock.elapsed(start)

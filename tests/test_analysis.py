@@ -410,6 +410,80 @@ def test_purity_jax_random_is_clean():
     """) == []
 
 
+# -- purity-telemetry-call ----------------------------------------------------
+
+@pytest.mark.parametrize("call", [
+    "telemetry.count('dmlc_x_total', 1)",
+    "telemetry.gauge_set('dmlc_x_depth', 3)",
+    "telemetry.observe('dmlc_x_seconds', 0.1)",
+    "telemetry.span('x')",
+])
+def test_purity_telemetry_call_in_traced_code_trips(call):
+    [f] = findings_of(f"""
+        import jax
+        from dmlc_core_tpu import telemetry
+
+        @jax.jit
+        def step(x):
+            {call}
+            return x * 2
+    """)
+    assert f.rule == "purity-telemetry-call"
+
+
+def test_purity_telemetry_direct_import_and_fs_metrics_trip():
+    rules = rules_of("""
+        import jax
+        from dmlc_core_tpu.io import fs_metrics
+        from dmlc_core_tpu.telemetry import span
+
+        @jax.jit
+        def step(x):
+            with span("x"):
+                fs_metrics.note_request("s3", "GET", 0.0, nread=1)
+            return x
+    """)
+    assert rules == ["purity-telemetry-call", "purity-telemetry-call"]
+
+
+def test_purity_telemetry_reaches_transitive_callees():
+    [f] = findings_of("""
+        import jax
+        from dmlc_core_tpu import telemetry
+
+        def _inner(x):
+            telemetry.count("dmlc_x_total")
+            return x
+
+        @jax.jit
+        def step(x):
+            return _inner(x)
+    """)
+    assert f.rule == "purity-telemetry-call"
+
+
+def test_purity_telemetry_outside_traced_code_is_clean():
+    # the clean twin: host-side metering around the jit boundary is the
+    # documented idiom, not a finding
+    assert rules_of("""
+        import jax
+        from dmlc_core_tpu import telemetry
+        from dmlc_core_tpu.telemetry import clock
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def train(x):
+            start = clock.monotonic()
+            with telemetry.span("train.step"):
+                out = step(x)
+            telemetry.observe("dmlc_train_step_seconds",
+                              clock.elapsed(start))
+            return out
+    """) == []
+
+
 # -- resource-unclosed --------------------------------------------------------
 
 def test_resource_unclosed_bare_expression_trips():

@@ -115,3 +115,19 @@ def test_roofline_absent_off_tpu(bench_run):
     # the roofline is a v5e lane-op model: meaningless (and previously
     # misleading, BENCH_r04.json) on a CPU run
     assert "roofline" not in result.get("detail", {})
+
+
+def test_detail_carries_telemetry_snapshot(bench_run):
+    """ISSUE 2 satellite: each emitted metric's detail carries the telemetry
+    registry snapshot, so BENCH rounds have per-stage attribution (parser
+    rows, pipeline bytes) — not just the headline rows/sec."""
+    proc, _ = bench_run
+    [line] = [l for l in proc.stdout.splitlines() if l.strip()]
+    result = json.loads(line)
+    families = result["detail"].get("telemetry")
+    assert isinstance(families, dict) and families, result["detail"].keys()
+    # the untimed pipeline smoke parses 2000 libsvm rows through the real
+    # text parser — that attribution must be present and exact
+    rows = sum(s["value"]
+               for s in families["dmlc_parser_rows_total"]["samples"])
+    assert rows == 2000, families["dmlc_parser_rows_total"]

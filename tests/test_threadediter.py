@@ -316,3 +316,154 @@ def test_destroy_is_idempotent_and_fast():
     it.destroy()
     it.destroy()
     assert time.time() - start < 5.0
+
+
+# -- telemetry / observability hooks ------------------------------------------
+
+def test_qsize_tracks_actual_occupancy_under_slow_consumer():
+    """The queue-depth gauge must report real occupancy: fill to capacity
+    with a blocked consumer, then watch qsize() step down 1:1 as items are
+    consumed, cross-checked against the telemetry gauge."""
+    from dmlc_core_tpu import telemetry
+
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        cap = 4
+        it = ThreadedIter(RangeProducer(32), max_capacity=cap,
+                          name="slowtest")
+        gauge = telemetry.get_registry().gauge(
+            "dmlc_threadediter_queue_depth", name="slowtest")
+        # slow consumer: let the producer fill the queue completely
+        deadline = time.time() + 5.0
+        while it.qsize() < cap and time.time() < deadline:
+            time.sleep(0.01)
+        assert it.qsize() == cap
+        seen = []
+        for k in range(8):
+            item = it.next()
+            assert item is not None
+            seen.append(item[0])
+            # the producer may refill concurrently, but occupancy can
+            # never exceed capacity and qsize() never goes negative
+            q = it.qsize()
+            assert 0 <= q <= cap
+            # the gauge is written under the same lock as the queue op:
+            # it must equal a fresh qsize() reading bracketing it
+            assert 0 <= gauge.value <= cap
+        assert seen == list(range(8))
+        # drain fully: at EOF occupancy is zero and the gauge agrees
+        while it.next() is not None:
+            pass
+        assert it.qsize() == 0
+        assert gauge.value == 0
+        it.destroy()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+        if was_enabled:
+            telemetry.enable()
+
+
+def test_stall_counters_and_hooks():
+    """A full queue counts producer stalls; an empty one counts consumer
+    stalls; the optional hooks fire once per episode."""
+    from dmlc_core_tpu import telemetry
+
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        hook_counts = {"producer": 0, "consumer": 0}
+        it = ThreadedIter(max_capacity=1, name="stalltest")
+        it.on_producer_stall = lambda: hook_counts.__setitem__(
+            "producer", hook_counts["producer"] + 1)
+        it.on_consumer_stall = lambda: hook_counts.__setitem__(
+            "consumer", hook_counts["consumer"] + 1)
+
+        class SlowProducer(RangeProducer):
+            def next(self, reuse):
+                time.sleep(0.05)
+                return super().next(reuse)
+
+        it.init(SlowProducer(3))
+        # consumer arrives before the slow producer's first item
+        assert drain(it) == [0, 1, 2]
+        assert it.consumer_stalls >= 1
+        assert hook_counts["consumer"] == it.consumer_stalls
+        reg = telemetry.get_registry()
+        assert reg.counter("dmlc_threadediter_consumer_stalls_total",
+                           name="stalltest").value == it.consumer_stalls
+        it.destroy()
+
+        # capacity-1 queue + paused consumer: the fast producer must stall
+        it2 = ThreadedIter(RangeProducer(16), max_capacity=1,
+                           name="stalltest2")
+        deadline = time.time() + 5.0
+        while it2.producer_stalls == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert drain(it2) == list(range(16))
+        assert it2.producer_stalls >= 1
+        assert reg.counter("dmlc_threadediter_producer_stalls_total",
+                           name="stalltest2").value == it2.producer_stalls
+        it2.destroy()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+        if was_enabled:
+            telemetry.enable()
+
+
+def test_telemetry_disabled_iteration_unchanged():
+    """With telemetry off (the default), iteration works and no metric
+    families appear."""
+    from dmlc_core_tpu import telemetry
+
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    try:
+        it = ThreadedIter(RangeProducer(64), max_capacity=4)
+        assert drain(it) == list(range(64))
+        assert it.qsize() == 0  # qsize() works regardless of telemetry state
+        it.destroy()
+        assert telemetry.get_registry().families() == []
+    finally:
+        if was_enabled:
+            telemetry.enable()
+
+
+def test_raising_stall_hook_does_not_kill_producer():
+    """A broken stall hook must not unwind the producer thread (a dead
+    producer with no error/_END posted would hang next() forever)."""
+    from dmlc_core_tpu import telemetry
+
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        it = ThreadedIter(RangeProducer(32), max_capacity=1, name="boomhook")
+
+        def boom():
+            raise RuntimeError("hook bug")
+
+        it.on_producer_stall = boom
+        it.on_consumer_stall = boom
+        assert drain(it) == list(range(32))  # completes despite raising hooks
+        assert it.producer_stalls + it.consumer_stalls >= 1
+        # raising hooks must not desync the exported counters either
+        reg = telemetry.get_registry()
+        assert reg.counter("dmlc_threadediter_producer_stalls_total",
+                           name="boomhook").value == it.producer_stalls
+        assert reg.counter("dmlc_threadediter_consumer_stalls_total",
+                           name="boomhook").value == it.consumer_stalls
+        it.destroy()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+        if was_enabled:
+            telemetry.enable()
