@@ -35,6 +35,7 @@ CLI_EXEMPT = {
     "dmlc_core_tpu/analysis/driver.py",  # this CLI reports to stdout
     "dmlc_core_tpu/telemetry/report.py",  # `telemetry report` CLI table
     "dmlc_core_tpu/telemetry/__main__.py",
+    "dmlc_core_tpu/fault/__main__.py",  # `fault validate` CLI report
 }
 
 # the deep passes run on library code only; tests/examples get syntax checks
@@ -72,6 +73,10 @@ ALL_RULES = {
     "resource-tempdir": (
         "tempfile.mkdtemp() result has no shutil.rmtree in a finally block "
         "(leaks the dir on non-anticipated exceptions)"),
+    "assert-in-protocol": (
+        "bare assert validating wire/peer-supplied data in tracker/ or io/ "
+        "(vanishes under python -O; crashes the serving thread instead of "
+        "rejecting the peer — raise ProtocolError)"),
     "style-no-print": "library code must log via utils.logging, not print()",
 }
 
@@ -251,13 +256,14 @@ def analyze_source(source: str, relpath: str = "<string>",
                         f"syntax error: {exc.msg}")]
     findings: List[Finding] = []
     if is_library:
-        from dmlc_core_tpu.analysis import lockset, purity, resources
+        from dmlc_core_tpu.analysis import lockset, protocol, purity, resources
 
         ctx = FileContext(relpath, source, tree, is_library,
                           cli_exempt=relpath in CLI_EXEMPT)
         findings += lockset.run(ctx)
         findings += purity.run(ctx)
         findings += resources.run(ctx)
+        findings += protocol.run(ctx)
     supp = suppressed_lines(source)
     findings = [f for f in findings
                 if not ({"all", f.rule} & supp.get(f.lineno, set()))]

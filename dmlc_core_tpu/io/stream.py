@@ -18,7 +18,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from dmlc_core_tpu import telemetry
+from dmlc_core_tpu import fault, telemetry
 from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ
 
 __all__ = [
@@ -54,6 +54,10 @@ class Stream:
         """Read exactly ``nbytes`` or raise (short read = corrupt input)."""
         chunks = []
         remaining = nbytes
+        if fault.enabled():
+            # an injected truncation models a cut object/dropped connection:
+            # the stream "ends" early and the short-read CHECK below fires
+            remaining = fault.truncate("io.stream.read", nbytes)
         while remaining > 0:
             chunk = self.read(remaining)
             if not chunk:
@@ -229,6 +233,8 @@ def create_stream(uri: str, mode: str, allow_null: bool = False) -> Optional[Str
         with telemetry.span("io.stream.open",
                             protocol=uri_obj.protocol or "file://",
                             mode=mode):
+            if fault.enabled():
+                fault.inject("io.stream.open", uri=uri, mode=mode)
             return fs.open(uri_obj, mode)
     except (OSError, IOError):
         if allow_null:
@@ -246,6 +252,8 @@ def create_stream_for_read(uri: str, allow_null: bool = False) -> Optional[SeekS
         with telemetry.span("io.stream.open",
                             protocol=uri_obj.protocol or "file://",
                             mode="r"):
+            if fault.enabled():
+                fault.inject("io.stream.open", uri=uri, mode="r")
             return fs.open_for_read(uri_obj)
     except (OSError, IOError):
         if allow_null:

@@ -31,7 +31,7 @@ import threading
 from collections import deque
 from typing import Any, Callable, Generic, Iterator, Optional, TypeVar
 
-from dmlc_core_tpu import telemetry
+from dmlc_core_tpu import fault, telemetry
 
 logger = logging.getLogger("dmlc_core_tpu.io")
 
@@ -201,6 +201,10 @@ class ThreadedIter(Generic[T]):
                     return True  # reset requested mid-epoch
                 reuse = self._free.popleft() if self._free else None
             try:
+                if fault.enabled():
+                    # injected producer faults ride the normal ferrying path:
+                    # the consumer sees them at next(), the thread survives
+                    fault.inject("threadediter.produce", name=self._name)
                 with telemetry.span("threadediter.produce", name=self._name):
                     item = self._producer.next(reuse)
             except BaseException as exc:  # noqa: BLE001

@@ -17,6 +17,19 @@ Reimplements the reference tracker protocol (tracker/dmlc_tracker/tracker.py):
   wire sequence as reference tracker.py:80-135, restructured here as
   topology push / brokering rounds / accept-registry bookkeeping).
 
+Unlike the reference, the control plane here is **deadline-hardened**
+(docs/robustness.md): wire-protocol violations raise :class:`ProtocolError`
+and are rejected per-connection (never an ``assert`` — one malformed client
+must not kill the daemon thread, and validation must survive ``python -O``);
+``DMLC_TRACKER_SOCK_TIMEOUT`` bounds every per-socket wait so a hung client
+cannot freeze the accept loop; ``DMLC_TRACKER_RENDEZVOUS_DEADLINE`` bounds
+the whole rendezvous with a clean shutdown; and a worker dying mid-brokering
+fails *that* rank with a structured entry in
+:attr:`RabitTracker.failed_ranks` instead of hanging the world.  The fault
+sites ``tracker.framed.recv`` / ``tracker.framed.send`` / ``tracker.accept``
+(:mod:`dmlc_core_tpu.fault`) let the chaos suite prove all of this under
+injected resets, truncation, and stalls.
+
 On TPU the data plane no longer consumes these links (XLA collectives do the
 reduction), but the tracker stays wire-compatible so existing Rabit clients
 (XGBoost binaries) can rendezvous against it unchanged; our own workers use
@@ -26,6 +39,7 @@ only the env contract + ``jax.distributed`` coordination.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import struct
 import subprocess
@@ -33,43 +47,98 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from dmlc_core_tpu import telemetry
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.param import get_env
 from dmlc_core_tpu.telemetry import clock
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
 
 MAGIC = 0xFF99
+# wire sanity bounds: strings in this protocol are job ids / commands /
+# hostnames and peer counts are world-sized — anything past these is a
+# corrupt or hostile frame, not a big job
+MAX_FRAME = 1 << 20
+MAX_PEERS = 1 << 16
+# brokering rounds before the tracker gives up on a conversation: an honest
+# client converges in a handful of rounds; an endless nerr!=0 loop means its
+# dial targets are gone (e.g. a peer process died after registering)
+MAX_BROKER_ROUNDS = 256
+
+
+class ProtocolError(Exception):
+    """A peer violated the rendezvous wire protocol (bad magic, malformed
+    frame, impossible count).  Raised — never asserted — so validation
+    survives ``python -O`` and the accept loop can reject just that peer."""
+
+
+class TrackerError(RuntimeError):
+    """Structured tracker-level failure surfaced by :meth:`RabitTracker.join`
+    (rendezvous deadline exceeded, or workers failed mid-rendezvous)."""
 
 
 class FramedSocket:
-    """int32/length-prefixed-string framing (reference ExSocket)."""
+    """int32/length-prefixed-string framing (reference ExSocket).
 
-    def __init__(self, sock: socket.socket):
+    ``timeout`` (seconds) bounds every blocking op on the underlying socket;
+    inbound string frames are validated against :data:`MAX_FRAME` and UTF-8
+    before they reach any caller.
+    """
+
+    def __init__(self, sock: socket.socket, timeout: Optional[float] = None):
         self.sock = sock
+        if timeout:
+            sock.settimeout(timeout)
 
     def recvall(self, nbytes: int) -> bytes:
+        budget = nbytes
+        if fault.enabled():
+            fault.inject("tracker.framed.recv", nbytes=nbytes)
+            budget = fault.truncate("tracker.framed.recv", nbytes)
         chunks = []
         nread = 0
-        while nread < nbytes:
-            chunk = self.sock.recv(min(nbytes - nread, 1024))
+        while nread < budget:
+            chunk = self.sock.recv(min(budget - nread, 1024))
             if not chunk:
-                raise ConnectionError("peer closed during recvall")
+                raise ConnectionError(
+                    f"peer closed during recvall ({nread}/{nbytes} bytes)")
             nread += len(chunk)
             chunks.append(chunk)
+        if budget < nbytes:
+            # injected truncation models the peer vanishing mid-frame
+            raise ConnectionError(
+                f"peer closed during recvall ({budget}/{nbytes} bytes)")
         return b"".join(chunks)
 
     def recvint(self) -> int:
         return struct.unpack("@i", self.recvall(4))[0]
 
+    def _sendall(self, data: bytes) -> None:
+        if fault.enabled():
+            fault.inject("tracker.framed.send", nbytes=len(data))
+        self.sock.sendall(data)
+
     def sendint(self, n: int) -> None:
-        self.sock.sendall(struct.pack("@i", n))
+        self._sendall(struct.pack("@i", n))
 
     def sendstr(self, s: str) -> None:
-        self.sendint(len(s))
-        self.sock.sendall(s.encode())
+        # length prefix counts encoded BYTES: len(s) would under-count any
+        # non-ASCII hostname/jobid and truncate the frame at the receiver
+        # (byte-identical to the reference for the ASCII protocol strings)
+        data = s.encode()
+        self.sendint(len(data))
+        self._sendall(data)
 
     def recvstr(self) -> str:
-        return self.recvall(self.recvint()).decode()
+        n = self.recvint()
+        if n < 0 or n > MAX_FRAME:
+            raise ProtocolError(
+                f"invalid string length {n} on the wire (bounds [0, "
+                f"{MAX_FRAME}])")
+        data = self.recvall(n)
+        try:
+            return data.decode()
+        except UnicodeDecodeError as exc:
+            raise ProtocolError(f"non-UTF-8 string payload: {exc}") from None
 
 
 def _resolve_ip(host: str) -> str:
@@ -81,13 +150,14 @@ class WorkerEntry:
     the link-brokering conversation (wire-compatible with Rabit's client
     side; message sequence documented on each method)."""
 
-    def __init__(self, sock: socket.socket, addr):
+    def __init__(self, sock: socket.socket, addr,
+                 timeout: Optional[float] = None):
         connect_start = clock.monotonic()
-        self.sock = FramedSocket(sock)
+        self.sock = FramedSocket(sock, timeout=timeout)
         self.host = _resolve_ip(addr[0])
         magic = self.sock.recvint()
         if magic != MAGIC:
-            raise ConnectionError(f"invalid magic {magic:#x} from {self.host}")
+            raise ProtocolError(f"invalid magic {magic:#x} from {self.host}")
         self.sock.sendint(MAGIC)
         self.rank = self.sock.recvint()
         self.world_size = self.sock.recvint()
@@ -155,11 +225,23 @@ class WorkerEntry:
         reach zero are fully linked and leave ``accept_registry``; this
         worker records its own outstanding inbound count.  Returns the
         ranks that became fully linked.
+
+        Everything the peer reports is validated (counts bounded, reported
+        peers must be assigned links) and a conversation that never
+        converges is cut off after :data:`MAX_BROKER_ROUNDS` — both raise
+        :class:`ProtocolError`, which the accept loop turns into a failed
+        rank rather than a dead tracker.
         """
-        while True:
-            reached = {self.sock.recvint()
-                       for _ in range(self.sock.recvint())}
-            assert reached <= links, (reached, links)
+        for _ in range(MAX_BROKER_ROUNDS):
+            nreached = self.sock.recvint()
+            if nreached < 0 or nreached > MAX_PEERS:
+                raise ProtocolError(
+                    f"rank {self.rank} reported {nreached} reached peers")
+            reached = {self.sock.recvint() for _ in range(nreached)}
+            if not reached <= links:
+                raise ProtocolError(
+                    f"rank {self.rank} reported links it was never "
+                    f"assigned: {sorted(reached - links)}")
             missing = links - reached
             dialable = [peer for peer in missing if peer in accept_registry]
             self.sock.sendint(len(dialable))
@@ -183,6 +265,9 @@ class WorkerEntry:
                 accept_registry.pop(peer, None)
             self.pending_accepts = len(missing) - len(dialable)
             return fully_linked
+        raise ProtocolError(
+            f"rank {self.rank} brokering did not converge within "
+            f"{MAX_BROKER_ROUNDS} rounds (dial targets unreachable?)")
 
     def assign_rank(self, rank: int,
                     accept_registry: Dict[int, "WorkerEntry"],
@@ -206,25 +291,57 @@ class WorkerEntry:
 
 def bind_free_port(host: str, port: int = 9091,
                    port_end: int = 9999) -> Tuple[socket.socket, int]:
-    """Bind the first free port in [port, port_end) (reference tracker.py:141-152)."""
+    """Bind the first free port in [port, port_end) (reference tracker.py:141-152).
+
+    The probe socket is closed on every failure path (exhausted range or a
+    non-EADDRINUSE bind error) — only a successful bind transfers ownership
+    to the caller.
+    """
     family = socket.getaddrinfo(host, None)[0][0]
     sock = socket.socket(family, socket.SOCK_STREAM)
-    for p in range(port, port_end):
-        try:
-            sock.bind((host, p))
-            return sock, p
-        except socket.error as err:
-            if err.errno in (98, 48):  # EADDRINUSE linux/mac
-                continue
-            raise
-    raise OSError(f"no free port in [{port}, {port_end})")
+    try:
+        for p in range(port, port_end):
+            try:
+                sock.bind((host, p))
+                return sock, p
+            except socket.error as err:
+                if err.errno in (98, 48):  # EADDRINUSE linux/mac
+                    continue
+                raise
+        raise OSError(f"no free port in [{port}, {port_end})")
+    except BaseException:
+        sock.close()
+        raise
 
 
 class RabitTracker:
-    """The rendezvous server (reference RabitTracker, tracker.py:137-334)."""
+    """The rendezvous server (reference RabitTracker, tracker.py:137-334).
+
+    Robustness knobs (docs/robustness.md; constructor args override env):
+
+    - ``sock_timeout`` / ``DMLC_TRACKER_SOCK_TIMEOUT`` (seconds, 0 = off):
+      applied to every accepted socket, so a client that connects and goes
+      silent times out instead of freezing the single-threaded accept loop;
+    - ``rendezvous_deadline`` / ``DMLC_TRACKER_RENDEZVOUS_DEADLINE``
+      (seconds, 0 = off): armed when the first worker knocks, disarmed once
+      all ranks started; while armed, every accepted socket's timeout is
+      additionally clamped to the remaining deadline, so even a hung
+      conversation cannot block the loop past it.  On expiry the tracker
+      closes every pending worker's socket (they observe a connection
+      error — a structured failure, not a hang), records :attr:`error`,
+      and shuts down cleanly; :meth:`join` then raises
+      :class:`TrackerError`.
+
+    After the run, :attr:`failed_ranks` maps each rank that died
+    mid-rendezvous to a structured message; :meth:`join` raises
+    :class:`TrackerError` when any exist, so callers cannot mistake a
+    degraded rendezvous for a clean one.
+    """
 
     def __init__(self, host_ip: str, num_workers: int, port: int = 9091,
-                 port_end: int = 9999):
+                 port_end: int = 9999,
+                 sock_timeout: Optional[float] = None,
+                 rendezvous_deadline: Optional[float] = None):
         self.sock, self.port = bind_free_port(host_ip, port, port_end)
         self.sock.listen(256)
         self.host_ip = host_ip
@@ -232,6 +349,16 @@ class RabitTracker:
         self.thread: Optional[threading.Thread] = None
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
+        self.sock_timeout = (sock_timeout if sock_timeout is not None
+                             else get_env("DMLC_TRACKER_SOCK_TIMEOUT",
+                                          float, 0.0))
+        self.rendezvous_deadline = (
+            rendezvous_deadline if rendezvous_deadline is not None
+            else get_env("DMLC_TRACKER_RENDEZVOUS_DEADLINE", float, 0.0))
+        # rank -> structured message for every worker that died mid-rendezvous
+        self.failed_ranks: Dict[int, str] = {}
+        # tracker-fatal condition (rendezvous deadline); join() raises it
+        self.error: Optional[str] = None
         logger.info("start listening on %s:%d", host_ip, self.port)
 
     # -- topology (tracker.py:165-252) ---------------------------------------
@@ -300,7 +427,60 @@ class RabitTracker:
                 "DMLC_TRACKER_PORT": str(self.port)}
 
     # -- accept loop (tracker.py:254-320) -------------------------------------
+    def _reject(self, sock: socket.socket, reason: str, detail) -> None:
+        """Reject one bad connection: log, count, close, carry on."""
+        logger.warning("rejected connection (%s): %s", reason, detail)
+        telemetry.count("dmlc_tracker_protocol_errors_total", reason=reason)
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _fail_worker(self, worker: WorkerEntry, rank: int,
+                     err: BaseException) -> None:
+        """A worker died mid-rendezvous: fail THAT rank, keep the world."""
+        msg = (f"rank {rank} ({worker.host}) failed during rendezvous: "
+               f"{type(err).__name__}: {err}")
+        logger.error("%s", msg)
+        self.failed_ranks[rank] = msg
+        telemetry.count("dmlc_tracker_worker_failures_total")
+        try:
+            worker.sock.sock.close()
+        except OSError:
+            pass
+
+    def _assign(self, worker: WorkerEntry, rank: int, accept_registry,
+                tree_map, parent_map, ring_map) -> bool:
+        """assign_rank with per-worker exception isolation."""
+        try:
+            worker.assign_rank(rank, accept_registry, tree_map, parent_map,
+                               ring_map)
+        except (ProtocolError, OSError) as err:
+            self._fail_worker(worker, rank, err)
+            return False
+        # a recovered rank is live again
+        self.failed_ranks.pop(rank, None)
+        return True
+
     def _accept_workers(self, n: int) -> None:
+        try:
+            self._accept_workers_inner(n)
+        except Exception as exc:  # noqa: BLE001 — ferried to join()
+            # the accept loop is the whole control plane: a crash here must
+            # surface as a structured tracker error, never a silently dead
+            # daemon thread with every worker blocked on it
+            logger.exception("tracker accept loop died")
+            self.error = (f"tracker accept loop died: "
+                          f"{type(exc).__name__}: {exc}")
+        finally:
+            # clean shutdown on every exit path: the port is freed and no
+            # late client can block on a listener nobody serves
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def _accept_workers_inner(self, n: int) -> None:
         shutdown: Dict[int, WorkerEntry] = {}
         accept_registry: Dict[int, WorkerEntry] = {}
         jobid_ranks: Dict[str, int] = {}
@@ -308,56 +488,128 @@ class RabitTracker:
         tree_map = None
         todo_nodes: List[int] = []
         barrier_start: Optional[float] = None
-        while len(shutdown) != n:
-            fd, addr = self.sock.accept()
+        deadline_at: Optional[float] = None
+        if self.rendezvous_deadline:
+            # poll accept so the deadline fires even with nobody knocking
+            self.sock.settimeout(0.1)
+        # a rank that failed mid-rendezvous is terminal unless it recovers;
+        # counting it lets the world finish instead of waiting forever for
+        # a shutdown that will never come
+        while len(set(shutdown) | set(self.failed_ranks)) < n:
+            if deadline_at is not None and clock.monotonic() > deadline_at:
+                self._rendezvous_expired(pending, todo_nodes, n)
+                return
             try:
-                s = WorkerEntry(fd, addr)
-            except ConnectionError as err:
-                logger.warning("rejected connection: %s", err)
-                fd.close()
+                fd, addr = self.sock.accept()
+            except socket.timeout:
+                continue
+            if deadline_at is None and self.rendezvous_deadline \
+                    and tree_map is None:
+                # armed by the first knock; disarmed once all ranks started
+                deadline_at = clock.monotonic() + self.rendezvous_deadline
+            # per-socket budget: the explicit sock_timeout, further clamped
+            # to the remaining rendezvous deadline — without this a single
+            # hung conversation would block the single-threaded loop PAST
+            # the deadline it is supposed to enforce
+            timeout = self.sock_timeout or None
+            if deadline_at is not None:
+                remaining = max(0.1, deadline_at - clock.monotonic())
+                timeout = remaining if timeout is None \
+                    else min(timeout, remaining)
+            try:
+                fault.inject("tracker.accept", host=addr[0])
+                s = WorkerEntry(fd, addr, timeout=timeout)
+            except (ProtocolError, OSError) as err:
+                self._reject(fd, "handshake", err)
                 continue
             if s.cmd == "print":
-                logger.info(s.sock.recvstr().strip())
+                try:
+                    msg = s.sock.recvstr()
+                except (ProtocolError, OSError) as err:
+                    self._reject(fd, "print", err)
+                    continue
+                logger.info(msg.strip())
                 continue
             if s.cmd == "shutdown":
-                assert s.rank >= 0 and s.rank not in shutdown
+                # rank must name a real slot: out-of-world shutdowns would
+                # otherwise count toward loop termination and end the
+                # rendezvous "cleanly" with the honest workers unserved
+                if s.rank < 0 or s.rank >= n or s.rank in shutdown:
+                    self._reject(fd, "shutdown",
+                                 f"bad shutdown rank {s.rank} from {s.host} "
+                                 f"(world {n})")
+                    continue
                 shutdown[s.rank] = s
                 logger.debug("shutdown signal from %d", s.rank)
                 continue
-            assert s.cmd in ("start", "recover"), s.cmd
+            if s.cmd not in ("start", "recover"):
+                self._reject(fd, "bad-cmd",
+                             f"unknown command {s.cmd!r} from {s.host}")
+                continue
             if barrier_start is None:
                 # barrier = first worker knocking until all n are started
                 barrier_start = s.connect_span[0]
             if tree_map is None:
-                assert s.cmd == "start"
+                if s.cmd != "start":
+                    self._reject(fd, "recover-before-start",
+                                 f"{s.cmd!r} from {s.host} before any "
+                                 "worker started")
+                    continue
+                if s.world_size > MAX_PEERS:
+                    # the announced world sizes topology dicts and the todo
+                    # list: an unbounded value is a corrupt frame, not a
+                    # big job — reject it before it allocates
+                    self._reject(fd, "world-out-of-range",
+                                 f"{s.host} announced world {s.world_size} "
+                                 f"(max {MAX_PEERS})")
+                    continue
                 if s.world_size > 0:
                     n = s.world_size
                 tree_map, parent_map, ring_map = self.get_link_map(n)
                 todo_nodes = list(range(n))
             else:
-                assert s.world_size in (-1, n)
-            if s.cmd == "recover":
-                assert s.rank >= 0
+                if s.world_size not in (-1, n):
+                    self._reject(fd, "world-mismatch",
+                                 f"{s.host} announced world {s.world_size}, "
+                                 f"expected {n}")
+                    continue
+            if s.cmd == "recover" and s.rank < 0:
+                self._reject(fd, "bad-recover-rank",
+                             f"recover without a rank from {s.host}")
+                continue
+            if s.rank >= n:
+                # a self-reported rank outside the world would index the
+                # topology maps (KeyError) — reject the frame, keep the loop
+                self._reject(fd, "rank-out-of-range",
+                             f"{s.host} reported rank {s.rank} outside "
+                             f"world {n}")
+                continue
             rank = s.resolve_rank(jobid_ranks)
             if rank == -1:
-                assert todo_nodes
+                if not todo_nodes:
+                    self._reject(fd, "extra-worker",
+                                 f"no rank slots left for {s.host} "
+                                 f"(world {n})")
+                    continue
                 pending.append(s)
                 if len(pending) == len(todo_nodes):
                     pending.sort(key=lambda x: x.host)
                     for p in pending:
-                        rank = todo_nodes.pop(0)
+                        prank = todo_nodes.pop(0)
                         if p.jobid != "NULL":
-                            jobid_ranks[p.jobid] = rank
-                        p.assign_rank(rank, accept_registry, tree_map,
-                                      parent_map, ring_map)
+                            jobid_ranks[p.jobid] = prank
+                        if not self._assign(p, prank, accept_registry,
+                                            tree_map, parent_map, ring_map):
+                            continue
                         if p.pending_accepts > 0:
-                            accept_registry[rank] = p
+                            accept_registry[prank] = p
                         logger.debug("%s from %s; assigned rank %d",
                                      p.cmd, p.host, p.rank)
                     pending = []
                 if not todo_nodes:
                     logger.info("@tracker all of %d nodes started", n)
                     self.start_time = time.time()
+                    deadline_at = None  # rendezvous over; workers may run long
                     if barrier_start is not None:
                         telemetry.record_span("rendezvous.barrier",
                                               barrier_start, clock.monotonic(),
@@ -365,14 +617,34 @@ class RabitTracker:
                         telemetry.observe("dmlc_rendezvous_barrier_seconds",
                                           clock.elapsed(barrier_start))
             else:
-                s.assign_rank(rank, accept_registry, tree_map, parent_map,
-                              ring_map)
-                logger.debug("%s signal from %d", s.cmd, s.rank)
-                if s.pending_accepts > 0:
-                    accept_registry[rank] = s
+                if self._assign(s, rank, accept_registry, tree_map,
+                                parent_map, ring_map):
+                    logger.debug("%s signal from %d", s.cmd, s.rank)
+                    if s.pending_accepts > 0:
+                        accept_registry[rank] = s
         self.end_time = time.time()
         logger.info("@tracker all nodes finished; %.3f secs between start and finish",
                     (self.end_time - (self.start_time or self.end_time)))
+
+    def _rendezvous_expired(self, pending: List[WorkerEntry],
+                            todo_nodes: List[int], n: int) -> None:
+        """Deadline hit mid-rendezvous: fail the stragglers, shut down clean.
+
+        Every pending worker's socket is closed so its client observes a
+        connection error (a structured failure on its side, within the
+        deadline) instead of blocking forever on a tracker that gave up.
+        """
+        missing = len(todo_nodes) if todo_nodes else n
+        self.error = (f"rendezvous deadline ({self.rendezvous_deadline:g}s) "
+                      f"exceeded: {len(pending)} worker(s) pending, "
+                      f"{missing} of {n} rank(s) never started")
+        logger.error("%s", self.error)
+        telemetry.count("dmlc_tracker_deadline_exceeded_total")
+        for p in pending:
+            try:
+                p.sock.sock.close()
+            except OSError:
+                pass
 
     def start(self, num_workers: Optional[int] = None) -> None:
         n = num_workers if num_workers is not None else self.num_workers
@@ -386,6 +658,14 @@ class RabitTracker:
             self.thread.join(0.1)
             if deadline is not None and time.time() > deadline:
                 raise TimeoutError("tracker did not finish in time")
+        if self.error:
+            raise TrackerError(self.error)
+        if self.failed_ranks:
+            detail = "; ".join(self.failed_ranks[r]
+                               for r in sorted(self.failed_ranks))
+            raise TrackerError(
+                f"rendezvous completed with {len(self.failed_ranks)} failed "
+                f"rank(s): {detail}")
 
     def alive(self) -> bool:
         return self.thread is not None and self.thread.is_alive()
@@ -404,7 +684,7 @@ class PSTracker:
         if cmd:
             sock, self.port = bind_free_port(host_ip, port, port_end)
             sock.close()  # scheduler process rebinds it
-            env = dict(__import__("os").environ)
+            env = dict(os.environ)
             env.update({k: str(v) for k, v in (envs or {}).items()})
             env["DMLC_ROLE"] = "scheduler"
             env["DMLC_PS_ROOT_URI"] = str(host_ip)

@@ -579,6 +579,64 @@ def test_tempdir_returned_is_clean():
     """) == []
 
 
+# -- assert-in-protocol -------------------------------------------------------
+
+TRACKER = "dmlc_core_tpu/tracker/_fixture.py"
+
+WIRE_ASSERT = """
+    def handshake(sock):
+        magic = sock.recvint()
+        assert magic == 0xFF99, magic
+        return magic
+"""
+
+
+def test_assert_in_protocol_trips_in_tracker():
+    [f] = findings_of(WIRE_ASSERT, relpath=TRACKER)
+    assert f.rule == "assert-in-protocol"
+    assert f.symbol == "handshake"
+
+
+def test_assert_in_protocol_trips_in_io():
+    rules = rules_of("""
+        def read_header(stream):
+            n = int.from_bytes(stream.read(4), "little")
+            assert n >= 0, n
+            return n
+    """, relpath="dmlc_core_tpu/io/_fixture.py")
+    assert rules == ["assert-in-protocol"]
+
+
+def test_assert_in_protocol_clean_twin_raises():
+    # the hardened idiom: explicit raise survives -O and fails one peer
+    assert rules_of("""
+        class ProtocolError(Exception):
+            pass
+
+        def handshake(sock):
+            magic = sock.recvint()
+            if magic != 0xFF99:
+                raise ProtocolError(f"invalid magic {magic:#x}")
+            return magic
+    """, relpath=TRACKER) == []
+
+
+def test_assert_in_protocol_ignores_pure_invariants():
+    # an internal invariant in topology/bookkeeping code (no wire ingest
+    # anywhere in the function) is not protocol validation
+    assert rules_of("""
+        def ring(order, tree_map):
+            assert len(order) == len(tree_map)
+            return order
+    """, relpath=TRACKER) == []
+
+
+def test_assert_in_protocol_scoped_to_network_layers():
+    # the same wire-shaped assert outside tracker//io/ is out of scope
+    assert rules_of(WIRE_ASSERT,
+                    relpath="dmlc_core_tpu/data/_fixture.py") == []
+
+
 # -- style-no-print -----------------------------------------------------------
 
 def test_no_print_trips_in_library():

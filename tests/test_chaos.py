@@ -1,0 +1,545 @@
+"""Chaos suite: the fault-injection acceptance gate (docs/robustness.md).
+
+Under injected faults — malformed magic, truncated/hostile frames, a client
+that hangs mid-handshake, a worker dying mid-brokering, a 503 storm,
+truncated FS reads — the tracker never deadlocks or dies: surviving workers
+finish, failed ranks get structured errors within the configured deadlines,
+and `net_retry` respects jitter/Retry-After/total deadline.
+
+Runs in the regular suite (every test is fast) AND as the dedicated CI
+``chaos`` job (``pytest -m chaos``) with the telemetry artifact uploaded.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.tracker.rendezvous import (MAGIC, FramedSocket,
+                                              ProtocolError, RabitTracker,
+                                              TrackerError)
+from tests.test_tracker import FakeRabitClient
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _start_in_thread(client, **kw):
+    """Run client.start in a thread, capturing any exception."""
+    box = {}
+
+    def run():
+        try:
+            client.start(**kw)
+        except BaseException as exc:  # noqa: BLE001 - ferried to the test
+            box["error"] = exc
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def _raw_connect(port):
+    s = socket.socket()
+    s.connect(("127.0.0.1", port))
+    return s
+
+
+# -- malformed handshakes -----------------------------------------------------
+
+def test_malformed_magic_rejected_tracker_survives():
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    bad = _raw_connect(tracker.port)
+    bad.sendall(struct.pack("@i", 0xDEAD))     # wrong magic
+    bad.close()
+    good = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(good)
+    t.join(20)
+    assert not t.is_alive() and "error" not in box
+    assert good.rank == 0
+    good.shutdown()
+    tracker.join(timeout=20)
+
+
+@pytest.mark.parametrize("frame", [
+    struct.pack("@i", MAGIC) + struct.pack("@i", -1) * 2
+    + struct.pack("@i", -7),                          # negative string length
+    struct.pack("@i", MAGIC) + struct.pack("@i", -1) * 2
+    + struct.pack("@i", 1 << 24),                     # oversized string length
+    struct.pack("@i", MAGIC) + struct.pack("@i", -1) * 2
+    + struct.pack("@i", 2) + b"\xff\xfe",             # non-UTF-8 jobid
+])
+def test_hostile_frames_rejected_tracker_survives(frame):
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    bad = _raw_connect(tracker.port)
+    bad.sendall(frame)
+    # drain the echoed magic so the close is orderly, then vanish
+    bad.settimeout(5)
+    try:
+        bad.recv(4)
+    except OSError:
+        pass
+    bad.close()
+    good = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(good)
+    t.join(20)
+    assert not t.is_alive() and "error" not in box
+    good.shutdown()
+    tracker.join(timeout=20)
+
+
+def test_bad_command_rejected_tracker_survives():
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    bad = FramedSocket(_raw_connect(tracker.port))
+    bad.sendint(MAGIC)
+    assert bad.recvint() == MAGIC
+    bad.sendint(-1)
+    bad.sendint(-1)
+    bad.sendstr("NULL")
+    bad.sendstr("frobnicate")                 # unknown command
+    bad.sock.close()
+    good = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(good)
+    t.join(20)
+    assert not t.is_alive() and "error" not in box
+    good.shutdown()
+    tracker.join(timeout=20)
+
+
+def test_extra_worker_beyond_world_rejected():
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    good = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(good)
+    t.join(20)
+    assert "error" not in box
+    # the world is full: a late joiner with no rank must be rejected,
+    # not parked in a pending list that can never batch
+    extra = FramedSocket(_raw_connect(tracker.port))
+    extra.sendint(MAGIC)
+    assert extra.recvint() == MAGIC
+    extra.sendint(-1)
+    extra.sendint(-1)
+    extra.sendstr("NULL")
+    extra.sendstr("start")
+    extra.sock.settimeout(5)
+    with pytest.raises(OSError):
+        # tracker closes the socket instead of assigning a rank
+        got = extra.recvall(4)
+        if not got:
+            raise ConnectionError("closed")
+    good.shutdown()
+    tracker.join(timeout=20)
+
+
+def test_out_of_world_rank_rejected_tracker_survives():
+    """Regression: a start frame self-reporting a rank outside the world
+    used to index the topology maps and kill the accept loop (KeyError)."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    bad = FramedSocket(_raw_connect(tracker.port))
+    bad.sendint(MAGIC)
+    assert bad.recvint() == MAGIC
+    bad.sendint(7)                            # rank 7 in a world of 1
+    bad.sendint(-1)
+    bad.sendstr("NULL")
+    bad.sendstr("start")
+    bad.sock.close()
+    good = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(good)
+    t.join(20)
+    assert not t.is_alive() and "error" not in box
+    assert good.rank == 0
+    good.shutdown()
+    tracker.join(timeout=20)
+
+
+def test_unbounded_world_size_rejected_tracker_survives():
+    """Regression: the first start frame's world_size was accepted
+    unbounded — one corrupt frame could allocate topology maps over
+    billions of ranks."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    bad = FramedSocket(_raw_connect(tracker.port))
+    bad.sendint(MAGIC)
+    assert bad.recvint() == MAGIC
+    bad.sendint(-1)
+    bad.sendint(2**30)                        # absurd announced world
+    bad.sendstr("NULL")
+    bad.sendstr("start")
+    bad.sock.close()
+    good = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(good)
+    t.join(20)
+    assert not t.is_alive() and "error" not in box
+    assert good.world == 1                    # hostile world never took hold
+    good.shutdown()
+    tracker.join(timeout=20)
+
+
+def test_bogus_shutdown_ranks_do_not_end_the_world():
+    """Regression: shutdown frames naming out-of-world ranks used to count
+    toward loop termination — n of them ended the rendezvous 'cleanly'
+    with the honest workers unserved."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    good = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(good)
+    t.join(20)
+    assert "error" not in box
+    for bogus_rank in (5, 6):
+        fs = FramedSocket(_raw_connect(tracker.port))
+        fs.sendint(MAGIC)
+        assert fs.recvint() == MAGIC
+        fs.sendint(bogus_rank)
+        fs.sendint(-1)
+        fs.sendstr("NULL")
+        fs.sendstr("shutdown")
+        fs.sock.close()
+    time.sleep(0.2)
+    assert tracker.alive(), "bogus shutdowns terminated the tracker"
+    good.shutdown()                           # the real rank-0 shutdown
+    tracker.join(timeout=20)
+
+
+# -- hangs and deadlines ------------------------------------------------------
+
+def test_hung_handshake_times_out_world_survives():
+    tracker = RabitTracker("127.0.0.1", 2, sock_timeout=0.5)
+    tracker.start(2)
+    hung = _raw_connect(tracker.port)
+    hung.sendall(struct.pack("@i", MAGIC))     # ...and then silence
+    t0 = time.monotonic()
+    clients = [FakeRabitClient("127.0.0.1", tracker.port) for _ in range(2)]
+    threads = [_start_in_thread(c) for c in clients]
+    for t, box in threads:
+        t.join(20)
+        assert not t.is_alive(), "rendezvous deadlocked behind a hung client"
+        assert "error" not in box
+    assert sorted(c.rank for c in clients) == [0, 1]
+    # the hung socket was rejected within the per-socket timeout, not hours
+    assert time.monotonic() - t0 < 15
+    hung.close()
+    for c in clients:
+        c.shutdown()
+    tracker.join(timeout=20)
+
+
+def test_worker_death_mid_brokering_fails_that_rank_only():
+    tracker = RabitTracker("127.0.0.1", 2, sock_timeout=2.0)
+    tracker.start(2)
+    # doomed worker: completes the handshake header, then dies before
+    # reading its topology
+    doomed = FramedSocket(_raw_connect(tracker.port))
+    doomed.sendint(MAGIC)
+    assert doomed.recvint() == MAGIC
+    doomed.sendint(-1)
+    doomed.sendint(2)
+    doomed.sendstr("NULL")
+    doomed.sendstr("start")
+    doomed.sock.close()                        # dead mid-rendezvous
+    survivor = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(survivor)
+    t.join(20)
+    assert not t.is_alive(), "survivor hung behind a dead worker"
+    assert "error" not in box
+    assert survivor.world == 2
+    survivor.shutdown()
+    # the tracker finishes (the dead rank is terminal, not awaited forever)
+    # and join() surfaces the structured per-rank failure
+    with pytest.raises(TrackerError, match="failed during rendezvous"):
+        tracker.join(timeout=20)
+    assert not tracker.alive()
+    assert len(tracker.failed_ranks) == 1
+    (msg,) = tracker.failed_ranks.values()
+    assert "failed during rendezvous" in msg
+
+
+def test_rendezvous_deadline_fires_despite_hung_conversation():
+    """Regression: with ONLY the rendezvous deadline set (no sock_timeout),
+    a client that connects and goes silent used to park the accept loop in
+    a blocking recv forever — the deadline could never fire.  The deadline
+    now clamps every accepted socket's timeout to the remaining budget."""
+    tracker = RabitTracker("127.0.0.1", 2, rendezvous_deadline=0.5)
+    tracker.start(2)
+    hung = _raw_connect(tracker.port)
+    hung.sendall(struct.pack("@i", MAGIC))     # ...then silence, socket OPEN
+    with pytest.raises(TrackerError, match="rendezvous deadline"):
+        tracker.join(timeout=20)
+    assert not tracker.alive()
+    hung.close()
+
+
+def test_rendezvous_deadline_clean_shutdown():
+    tracker = RabitTracker("127.0.0.1", 2, rendezvous_deadline=0.5)
+    tracker.start(2)
+    # one worker shows up; its partner never does
+    lonely = FramedSocket(_raw_connect(tracker.port))
+    lonely.sendint(MAGIC)
+    assert lonely.recvint() == MAGIC
+    lonely.sendint(-1)
+    lonely.sendint(2)
+    lonely.sendstr("NULL")
+    lonely.sendstr("start")
+    t0 = time.monotonic()
+    lonely.sock.settimeout(10)
+    # within the deadline the pending worker gets a structured failure
+    # (connection closed by the tracker), not an eternal block
+    with pytest.raises(OSError):
+        got = lonely.sock.recv(4)
+        if not got:
+            raise ConnectionError("closed by tracker")
+    assert time.monotonic() - t0 < 5
+    with pytest.raises(TrackerError, match="rendezvous deadline"):
+        tracker.join(timeout=20)
+    assert not tracker.alive()
+    assert "deadline" in (tracker.error or "")
+
+
+# -- plan-driven injection through the tracker sites --------------------------
+
+def test_injected_handshake_reset_then_recovery():
+    fault.configure({"rules": [
+        {"site": "tracker.framed.recv", "kind": "reset",
+         "message": "chaos: handshake reset"}]})
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    first = FakeRabitClient("127.0.0.1", tracker.port)
+    t, box = _start_in_thread(first)
+    t.join(20)
+    # the injected reset killed the first handshake (client sees the close)
+    assert "error" in box
+    assert fault.fires() == [("tracker.framed.recv", "reset", 0)]
+    # the tracker survived: the next client rendezvouses normally
+    second = FakeRabitClient("127.0.0.1", tracker.port)
+    t2, box2 = _start_in_thread(second)
+    t2.join(20)
+    assert not t2.is_alive() and "error" not in box2
+    assert second.rank == 0
+    second.shutdown()
+    tracker.join(timeout=20)
+    first.listen_sock.close()
+
+
+def test_injected_accept_stall_delays_but_completes():
+    fault.configure({"rules": [
+        {"site": "tracker.accept", "kind": "stall", "seconds": 0.3}]})
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    client = FakeRabitClient("127.0.0.1", tracker.port)
+    t0 = time.monotonic()
+    t, box = _start_in_thread(client)
+    t.join(20)
+    assert not t.is_alive() and "error" not in box
+    assert time.monotonic() - t0 >= 0.25      # the stall really happened
+    client.shutdown()
+    tracker.join(timeout=20)
+    assert fault.fires()[0][:2] == ("tracker.accept", "stall")
+
+
+def test_injected_truncation_is_a_connection_error():
+    # a FramedSocket read under injected truncation = peer died mid-frame
+    fault.configure({"rules": [
+        {"site": "tracker.framed.recv", "kind": "truncate", "keep": 2}]})
+    a, b = socket.socketpair()
+    try:
+        b.sendall(struct.pack("@i", MAGIC))
+        with pytest.raises(ConnectionError, match="2/4 bytes"):
+            FramedSocket(a).recvint()
+    finally:
+        a.close()
+        b.close()
+
+
+# -- io-layer chaos -----------------------------------------------------------
+
+def test_truncated_fs_read_is_a_structured_error(tmp_path):
+    from dmlc_core_tpu.io.stream import create_stream_for_read
+
+    path = tmp_path / "blob.bin"
+    path.write_bytes(b"x" * 64)
+    fault.configure({"rules": [
+        {"site": "io.stream.read", "kind": "truncate", "keep": 10}]})
+    stream = create_stream_for_read(str(path))
+    with pytest.raises(Exception, match="short read"):
+        stream.read_exact(64)
+    stream.close()
+    assert fault.fires() == [("io.stream.read", "truncate", 0)]
+
+
+def test_stream_open_fault_honors_allow_null(tmp_path):
+    from dmlc_core_tpu.io.stream import create_stream
+
+    path = tmp_path / "data.txt"
+    path.write_text("hello")
+    fault.configure({"rules": [
+        {"site": "io.stream.open", "kind": "error", "exception": "OSError",
+         "message": "chaos: open failed"}]})
+    assert create_stream(str(path), "r", allow_null=True) is None
+    # rule fired out: the next open succeeds
+    stream = create_stream(str(path), "r", allow_null=True)
+    assert stream is not None
+    stream.close()
+
+
+def test_threadediter_injected_fault_ferried_then_restartable():
+    from dmlc_core_tpu.io.threadediter import ThreadedIter
+
+    fault.configure({"rules": [
+        {"site": "threadediter.produce", "kind": "error",
+         "exception": "ValueError", "message": "chaos: producer blip",
+         "after": 2}]})
+    it = ThreadedIter.from_factory(lambda: range(5), max_capacity=2,
+                                   name="chaos")
+    got = []
+    with pytest.raises(ValueError, match="producer blip"):
+        while True:
+            item = it.next()
+            if item is None:
+                break
+            got.append(item)
+    assert got == [0, 1]               # the two pre-fault items arrived
+    # the epoch restart after the (exhausted) fault is clean end-to-end
+    it.before_first()
+    assert list(it) == [0, 1, 2, 3, 4]
+    it.destroy()
+
+
+# -- net_retry chaos ----------------------------------------------------------
+
+def test_503_storm_retries_honor_retry_after(monkeypatch):
+    from dmlc_core_tpu.io import net_retry
+
+    sleeps = []
+    monkeypatch.setattr(net_retry.time, "sleep", sleeps.append)
+    fault.configure({"rules": [
+        {"site": "net.request", "kind": "http_status", "status": 503,
+         "headers": {"Retry-After": "1.5"}, "body": "SlowDown",
+         "times": 3}]})
+    calls = {"n": 0}
+
+    def perform():
+        calls["n"] += 1
+        return 200, {}, b"ok"
+
+    status, _, data = net_retry.request_with_retries(perform, (200,),
+                                                     "GET /chaos")
+    assert (status, data) == (200, b"ok")
+    assert calls["n"] == 1             # the storm never reached the server
+    assert len(sleeps) == 3
+    # Retry-After is a floor under the jittered backoff
+    assert all(s >= 1.5 for s in sleeps)
+
+
+def test_503_storm_exhaustion_returns_last_response(monkeypatch):
+    from dmlc_core_tpu.io import net_retry
+
+    monkeypatch.setattr(net_retry.time, "sleep", lambda s: None)
+    fault.configure({"rules": [
+        {"site": "net.request", "kind": "http_status", "status": 503,
+         "body": "busy", "times": None}]})
+    status, _, data = net_retry.request_with_retries(
+        lambda: (200, {}, b"never reached"), (200,), "GET /chaos")
+    assert (status, data) == (503, b"busy")
+    assert len(fault.fires()) == 4     # initial attempt + 3 retries
+
+
+def test_net_retry_total_deadline_stops_the_storm(monkeypatch):
+    from dmlc_core_tpu.io import net_retry
+
+    monkeypatch.setenv("DMLC_NET_RETRY_DEADLINE", "0.05")
+    fault.configure({"rules": [
+        {"site": "net.request", "kind": "http_status", "status": 503,
+         "headers": {"Retry-After": "30"}, "times": None}]})
+    t0 = time.monotonic()
+    status, _, _ = net_retry.request_with_retries(
+        lambda: (200, {}, b""), (200,), "GET /chaos")
+    # a 30s Retry-After would blow the 50ms budget: fail NOW instead
+    assert status == 503
+    assert time.monotonic() - t0 < 2
+    assert len(fault.fires()) == 1
+
+
+def test_injected_transport_reset_deadline_raises(monkeypatch):
+    from dmlc_core_tpu.io import net_retry
+
+    monkeypatch.setenv("DMLC_NET_RETRY_DEADLINE", "0.0001")
+    fault.configure({"rules": [
+        {"site": "net.request", "kind": "reset", "times": None}]})
+    time.sleep(0.001)  # guarantee the (tiny) deadline is already spent
+    with pytest.raises(ConnectionResetError):
+        net_retry.request_with_retries(lambda: (200, {}, b""), (200,),
+                                       "GET /chaos")
+    assert len(fault.fires()) == 1     # no doomed backoff, immediate raise
+
+
+# -- observability of chaos runs ----------------------------------------------
+
+def test_fired_faults_are_counted_through_telemetry():
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        # delta, not absolute: under DMLC_TELEMETRY_DIR the whole suite
+        # shares one registry and earlier chaos tests fire this site too
+        counter = telemetry.get_registry().counter(
+            "dmlc_fault_injected_total", site="tracker.framed.recv",
+            kind="reset")
+        before = counter.value
+        fault.configure({"rules": [
+            {"site": "tracker.framed.recv", "kind": "reset"}]})
+        a, b = socket.socketpair()
+        try:
+            with pytest.raises(ConnectionResetError):
+                FramedSocket(a).recvint()
+        finally:
+            a.close()
+            b.close()
+        assert counter.value == before + 1
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_protocol_errors_are_counted():
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        tracker = RabitTracker("127.0.0.1", 1)
+        tracker.start(1)
+        bad = _raw_connect(tracker.port)
+        bad.sendall(struct.pack("@i", 0xBEEF))
+        bad.close()
+        good = FakeRabitClient("127.0.0.1", tracker.port)
+        t, box = _start_in_thread(good)
+        t.join(20)
+        assert "error" not in box
+        good.shutdown()
+        tracker.join(timeout=20)
+        counter = telemetry.get_registry().counter(
+            "dmlc_tracker_protocol_errors_total", reason="handshake")
+        assert counter.value >= 1
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_disabled_mode_is_cheap():
+    # the whole disabled-mode cost is one attribute load + branch: 50k
+    # no-op injections must be effectively free (loose bound for CI noise)
+    assert not fault.enabled()
+    t0 = time.monotonic()
+    for _ in range(50_000):
+        fault.inject("tracker.framed.recv", nbytes=4)
+    assert time.monotonic() - t0 < 2.0

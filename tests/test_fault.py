@@ -1,0 +1,292 @@
+"""Fault subsystem unit tests: plan parsing/validation, firing discipline
+(after/times/probability/match), determinism by seed, every kind's behavior,
+env bring-up, the telemetry counter, and the CLI.
+
+The chaos tests that drive plans through the real tracker/io subsystems
+live in tests/test_chaos.py.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.fault import FaultPlan, FaultPlanError
+from dmlc_core_tpu.fault.__main__ import main as fault_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+# -- plan parsing / validation ------------------------------------------------
+
+def test_disabled_by_default_and_noop():
+    assert not fault.enabled()
+    fault.inject("tracker.framed.recv", nbytes=4)   # no-op, no raise
+    assert fault.truncate("io.stream.read", 100) == 100
+    assert fault.http_response("net.request") is None
+    assert fault.fires() == []
+
+
+def test_configure_from_json_text_and_dict():
+    fault.configure('{"rules": [{"site": "x", "kind": "reset"}]}')
+    assert fault.enabled()
+    plan = fault.configure({"seed": 3, "rules": []})
+    assert plan.seed == 3 and plan.rules == []
+
+
+@pytest.mark.parametrize("bad", [
+    "not json",
+    "[1, 2]",
+    {"bogus": 1},
+    {"rules": [{"kind": "reset"}]},                      # no site
+    {"rules": [{"site": "x"}]},                          # no kind
+    {"rules": [{"site": "x", "kind": "frobnicate"}]},    # unknown kind
+    {"rules": [{"site": "x", "kind": "reset", "nope": 1}]},
+    {"rules": [{"site": "x", "kind": "reset", "after": -1}]},
+    {"rules": [{"site": "x", "kind": "reset", "times": 0}]},
+    {"rules": [{"site": "x", "kind": "reset", "probability": 0.0}]},
+    {"rules": [{"site": "x", "kind": "reset", "probability": 1.5}]},
+    {"rules": [{"site": "x", "kind": "error", "exception": "SystemExit"}]},
+    {"rules": [{"site": "x", "kind": "truncate", "fraction": 1.0}]},
+    # mistyped values must be FaultPlanError (the validate CLI's 0/2
+    # contract), never a raw ValueError/TypeError traceback
+    {"rules": [{"site": "x", "kind": "http_status", "status": "5xx"}]},
+    {"rules": [{"site": "x", "kind": "delay", "seconds": "soon"}]},
+    {"rules": [{"site": "x", "kind": "reset", "after": "two"}]},
+    {"rules": [{"site": "x", "kind": "reset", "times": "many"}]},
+    {"rules": [{"site": "x", "kind": "reset", "probability": "likely"}]},
+    {"rules": [{"site": "x", "kind": "truncate", "keep": "few"}]},
+    {"rules": [{"site": "x", "kind": "truncate", "fraction": "half"}]},
+    {"rules": [{"site": "x", "kind": "exit", "code": "one"}]},
+    {"rules": [{"site": "x", "kind": "http_status", "body": 123}]},
+])
+def test_invalid_plans_raise(bad):
+    with pytest.raises(FaultPlanError):
+        FaultPlan(bad)
+
+
+# -- firing discipline --------------------------------------------------------
+
+def test_fires_once_by_default():
+    fault.configure({"rules": [{"site": "s", "kind": "reset"}]})
+    with pytest.raises(ConnectionResetError):
+        fault.inject("s")
+    fault.inject("s")  # second hit: rule exhausted, no fire
+    assert fault.fires() == [("s", "reset", 0)]
+
+
+def test_after_skips_hits_and_times_bounds_fires():
+    fault.configure({"rules": [
+        {"site": "s", "kind": "error", "exception": "ValueError",
+         "after": 2, "times": 2},
+    ]})
+    fault.inject("s")
+    fault.inject("s")      # first two hits skipped
+    for _ in range(2):
+        with pytest.raises(ValueError):
+            fault.inject("s")
+    fault.inject("s")      # fired out
+    assert len(fault.fires()) == 2
+
+
+def test_match_filters_on_context():
+    fault.configure({"rules": [
+        {"site": "threadediter.produce", "kind": "reset",
+         "match": {"name": "parse"}, "times": None},
+    ]})
+    fault.inject("threadediter.produce", name="loader")   # no match
+    with pytest.raises(ConnectionResetError):
+        fault.inject("threadediter.produce", name="parse")
+
+
+def test_site_wildcards():
+    fault.configure({"rules": [
+        {"site": "tracker.framed.*", "kind": "reset", "times": None}]})
+    with pytest.raises(ConnectionResetError):
+        fault.inject("tracker.framed.recv")
+    with pytest.raises(ConnectionResetError):
+        fault.inject("tracker.framed.send")
+    fault.inject("net.request")  # out of pattern
+
+
+def test_probability_is_deterministic_by_seed():
+    def decisions(seed):
+        fault.configure({"seed": seed, "rules": [
+            {"site": "s", "kind": "delay", "seconds": 0.0,
+             "probability": 0.5, "times": None}]})
+        out = []
+        for _ in range(32):
+            before = len(fault.fires())
+            fault.inject("s")
+            out.append(len(fault.fires()) > before)
+        return out
+
+    a, b, c = decisions(7), decisions(7), decisions(8)
+    assert a == b                     # same seed -> same chaos
+    assert a != c                     # different seed -> different stream
+    assert 0 < sum(a) < 32            # actually probabilistic
+
+
+def test_first_eligible_rule_wins_but_all_count_hits():
+    fault.configure({"rules": [
+        {"site": "s", "kind": "delay", "seconds": 0.0, "after": 1},
+        {"site": "s", "kind": "error", "exception": "ValueError",
+         "after": 1, "times": None},
+    ]})
+    fault.inject("s")                  # hit 1: both skip (after=1)
+    fault.inject("s")                  # hit 2: delay rule fires (first)
+    with pytest.raises(ValueError):
+        fault.inject("s")              # hit 3: delay exhausted, error fires
+    assert [k for _, k, _ in fault.fires()] == ["delay", "error"]
+
+
+# -- kinds --------------------------------------------------------------------
+
+def test_delay_sleeps():
+    fault.configure({"rules": [
+        {"site": "s", "kind": "delay", "seconds": 0.05}]})
+    t0 = time.monotonic()
+    fault.inject("s")
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_error_kind_raises_named_exception():
+    fault.configure({"rules": [
+        {"site": "s", "kind": "error", "exception": "socket.timeout",
+         "message": "injected hang"}]})
+    with pytest.raises(socket.timeout, match="injected hang"):
+        fault.inject("s")
+
+
+def test_truncate_keep_and_fraction():
+    fault.configure({"rules": [
+        {"site": "a", "kind": "truncate", "keep": 3},
+        {"site": "b", "kind": "truncate", "fraction": 0.5},
+    ]})
+    assert fault.truncate("a", 10) == 3
+    assert fault.truncate("a", 10) == 10   # fired out
+    assert fault.truncate("b", 10) == 5
+
+
+def test_http_response_injects():
+    fault.configure({"rules": [
+        {"site": "net.request", "kind": "http_status", "status": 503,
+         "headers": {"Retry-After": "2"}, "body": "SlowDown"}]})
+    status, headers, body = fault.http_response("net.request")
+    assert (status, body) == (503, b"SlowDown")
+    assert headers == {"retry-after": "2"}
+    assert fault.http_response("net.request") is None
+
+
+def test_exit_kind_kills_a_subprocess_at_site():
+    # worker kill-at-site: the plan rides DMLC_FAULT_PLAN into a child
+    # process, which dies with the plan's exit code at the named site
+    plan = {"rules": [{"site": "worker.phase", "kind": "exit", "code": 41}]}
+    env = dict(os.environ, DMLC_FAULT_PLAN=json.dumps(plan),
+               PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from dmlc_core_tpu import fault\n"
+         "fault.inject('worker.phase')\n"
+         "raise SystemExit(0)\n"],
+        env=env, capture_output=True, timeout=60)
+    assert proc.returncode == 41
+
+
+# -- env bring-up -------------------------------------------------------------
+
+def test_env_plan_file_form(tmp_path):
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps(
+        {"rules": [{"site": "s", "kind": "reset"}]}))
+    env = dict(os.environ, DMLC_FAULT_PLAN=f"@{plan_file}", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "from dmlc_core_tpu import fault\n"
+         "assert fault.enabled()\n"
+         "assert len(fault.get_plan().rules) == 1\n"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_env_malformed_plan_fails_loudly():
+    env = dict(os.environ, DMLC_FAULT_PLAN="{broken", PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", "import dmlc_core_tpu.fault"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "not valid JSON" in proc.stderr
+
+
+# -- telemetry ----------------------------------------------------------------
+
+def test_fired_faults_counted(monkeypatch):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        fault.configure({"rules": [
+            {"site": "s", "kind": "delay", "seconds": 0.0, "times": 3}]})
+        for _ in range(3):
+            fault.inject("s")
+        counter = telemetry.get_registry().counter(
+            "dmlc_fault_injected_total", site="s", kind="delay")
+        assert counter.value == 3
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_list_sites(capsys):
+    assert fault_cli(["list-sites"]) == 0
+    out = capsys.readouterr().out
+    for site in ("tracker.framed.recv", "net.request", "io.stream.open",
+                 "threadediter.produce"):
+        assert site in out
+
+
+def test_cli_validate_good_and_bad(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"seed": 1, "rules": [
+        {"site": "net.request", "kind": "http_status", "status": 503}]}))
+    assert fault_cli(["validate", str(good)]) == 0
+    out = capsys.readouterr().out
+    assert "plan ok" in out and "http_status" in out
+
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"rules": [{"site": "x", "kind": "nope"}]}')
+    assert fault_cli(["validate", str(bad)]) == 2
+    assert "invalid plan" in capsys.readouterr().err
+
+    # a mistyped field value is a clean exit 2, not a traceback
+    bad.write_text(
+        '{"rules": [{"site": "x", "kind": "http_status", "status": "5xx"}]}')
+    assert fault_cli(["validate", str(bad)]) == 2
+    assert "invalid 'status'" in capsys.readouterr().err
+
+    assert fault_cli(["validate", str(tmp_path / "missing.json")]) == 2
+
+
+def test_cli_validate_warns_on_unknown_exact_site(tmp_path, capsys):
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"rules": [
+        {"site": "tracker.framed.recv", "kind": "reset"},
+        {"site": "no.such.site", "kind": "reset"},
+        {"site": "tracker.*", "kind": "reset"},          # wildcard: no warn
+    ]}))
+    assert fault_cli(["validate", str(plan)]) == 0
+    err = capsys.readouterr().err
+    assert "no.such.site" in err and "tracker.*" not in err

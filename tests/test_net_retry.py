@@ -1,0 +1,167 @@
+"""net_retry policy tests: full-jitter backoff bounds, Retry-After honoring
+(delta-seconds and HTTP-date), the total-elapsed deadline, and the classic
+retry/exhaustion behavior the S3/Azure clients rely on.
+
+Chaos-driven variants (injected 503 storms) live in tests/test_chaos.py.
+"""
+
+import email.utils
+import random
+import time as real_time
+
+import pytest
+
+from dmlc_core_tpu.io import net_retry
+
+
+@pytest.fixture
+def sleeps(monkeypatch):
+    """Capture every backoff sleep instead of actually sleeping."""
+    recorded = []
+    monkeypatch.setattr(net_retry.time, "sleep", recorded.append)
+    return recorded
+
+
+def _storm(n_failures, status=503, headers=None):
+    """perform() that fails ``n_failures`` times, then returns 200."""
+    calls = {"n": 0}
+
+    def perform():
+        calls["n"] += 1
+        if calls["n"] <= n_failures:
+            return status, dict(headers or {}), b"busy"
+        return 200, {}, b"ok"
+
+    perform.calls = calls
+    return perform
+
+
+# -- retry basics -------------------------------------------------------------
+
+def test_transient_status_retried_to_success(sleeps):
+    perform = _storm(2)
+    status, _, data = net_retry.request_with_retries(perform, (200,), "GET /")
+    assert (status, data) == (200, b"ok")
+    assert perform.calls["n"] == 3 and len(sleeps) == 2
+
+
+def test_transport_error_retried_then_raises_on_exhaustion(sleeps):
+    def always_reset():
+        raise ConnectionResetError("nope")
+
+    with pytest.raises(ConnectionResetError):
+        net_retry.request_with_retries(always_reset, (200,), "GET /")
+    assert len(sleeps) == 3            # S3_MAX_ERROR_RETRY default
+
+
+def test_ok_status_returns_immediately_even_if_retryable(sleeps):
+    # a caller that treats 503 as ok (unusual but allowed) gets it at once
+    status, _, _ = net_retry.request_with_retries(
+        lambda: (503, {}, b""), (200, 503), "GET /")
+    assert status == 503 and sleeps == []
+
+
+def test_non_retryable_status_returned_without_retry(sleeps):
+    status, _, _ = net_retry.request_with_retries(
+        lambda: (404, {}, b"missing"), (200,), "GET /")
+    assert status == 404 and sleeps == []
+
+
+# -- full jitter --------------------------------------------------------------
+
+def test_backoff_is_jittered_within_doubling_windows(sleeps, monkeypatch):
+    monkeypatch.setattr(net_retry, "_rng", random.Random(1234))
+    perform = _storm(3)
+    net_retry.request_with_retries(perform, (200,), "GET /")
+    assert len(sleeps) == 3
+    for attempt, slept in enumerate(sleeps):
+        assert 0.0 <= slept < net_retry.BACKOFF_BASE * (2 ** attempt)
+    # jitter means the schedule is NOT the deterministic 0.1/0.2/0.4 ladder
+    assert sleeps != [0.1, 0.2, 0.4]
+
+
+def test_jitter_decorrelates_two_clients(sleeps, monkeypatch):
+    # two retry envelopes (fresh RNG streams) must not sleep identically —
+    # synchronized fleets re-thundering is what full jitter exists to stop
+    monkeypatch.setattr(net_retry, "_rng", random.Random(1))
+    net_retry.request_with_retries(_storm(3), (200,), "GET /a")
+    first = list(sleeps)
+    sleeps.clear()
+    monkeypatch.setattr(net_retry, "_rng", random.Random(2))
+    net_retry.request_with_retries(_storm(3), (200,), "GET /b")
+    assert sleeps != first
+
+
+def test_backoff_window_capped(monkeypatch):
+    monkeypatch.setattr(net_retry, "_rng", random.Random(7))
+    # attempt 30 would be ~100 million seconds pre-cap
+    delay = net_retry._backoff(30, None, 0.0, real_time.monotonic())
+    assert 0.0 <= delay <= net_retry.BACKOFF_CAP
+
+
+# -- Retry-After --------------------------------------------------------------
+
+def test_retry_after_seconds_is_a_floor(sleeps):
+    perform = _storm(1, headers={"Retry-After": "2.5"})
+    net_retry.request_with_retries(perform, (200,), "GET /")
+    assert len(sleeps) == 1 and sleeps[0] >= 2.5
+
+
+def test_retry_after_header_case_insensitive(sleeps):
+    perform = _storm(1, headers={"RETRY-AFTER": "1.25"})
+    net_retry.request_with_retries(perform, (200,), "GET /")
+    assert sleeps[0] >= 1.25
+
+
+def test_retry_after_http_date(sleeps):
+    when = email.utils.formatdate(real_time.time() + 3, usegmt=True)
+    perform = _storm(1, headers={"Retry-After": when})
+    net_retry.request_with_retries(perform, (200,), "GET /")
+    # clock skew between formatdate and the parse: stay loose
+    assert 1.0 <= sleeps[0] <= 4.0
+
+
+def test_retry_after_garbage_ignored(sleeps):
+    perform = _storm(1, headers={"Retry-After": "soon-ish"})
+    net_retry.request_with_retries(perform, (200,), "GET /")
+    assert len(sleeps) == 1 and sleeps[0] < net_retry.BACKOFF_BASE
+
+
+def test_retry_after_capped(sleeps):
+    perform = _storm(1, headers={"Retry-After": "86400"})
+    net_retry.request_with_retries(perform, (200,), "GET /")
+    assert sleeps[0] <= net_retry.RETRY_AFTER_CAP
+
+
+# -- total deadline -----------------------------------------------------------
+
+def test_deadline_skips_doomed_backoff_and_returns(sleeps, monkeypatch):
+    monkeypatch.setenv("DMLC_NET_RETRY_DEADLINE", "0.05")
+    perform = _storm(10, headers={"Retry-After": "30"})
+    t0 = real_time.monotonic()
+    status, _, _ = net_retry.request_with_retries(perform, (200,), "GET /")
+    assert status == 503               # the FINAL failure, surfaced now
+    assert perform.calls["n"] == 1 and sleeps == []
+    assert real_time.monotonic() - t0 < 2
+
+
+def test_deadline_zero_means_unbounded(sleeps, monkeypatch):
+    monkeypatch.setenv("DMLC_NET_RETRY_DEADLINE", "0")
+    status, _, _ = net_retry.request_with_retries(_storm(3), (200,), "GET /")
+    assert status == 200 and len(sleeps) == 3
+
+
+def test_deadline_transport_raises_instead_of_sleeping(monkeypatch):
+    monkeypatch.setenv("DMLC_NET_RETRY_DEADLINE", "0.0001")
+    calls = {"n": 0}
+
+    def reset_once():
+        calls["n"] += 1
+        raise BrokenPipeError("gone")
+
+    real_time.sleep(0.001)
+    t0 = real_time.monotonic()
+    with pytest.raises(BrokenPipeError):
+        net_retry.request_with_retries(reset_once, (200,), "GET /")
+    assert calls["n"] == 1
+    assert real_time.monotonic() - t0 < 1

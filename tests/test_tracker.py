@@ -16,8 +16,9 @@ import time
 import pytest
 
 from dmlc_core_tpu.tracker.opts import get_opts, parse_memory_mb
-from dmlc_core_tpu.tracker.rendezvous import (MAGIC, FramedSocket,
-                                              RabitTracker, WorkerEntry)
+from dmlc_core_tpu.tracker.rendezvous import (MAGIC, MAX_FRAME, FramedSocket,
+                                              ProtocolError, RabitTracker,
+                                              WorkerEntry, bind_free_port)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -283,6 +284,158 @@ def test_rendezvous_realizes_every_link(n):
     for c in clients:
         c.shutdown()
     tracker.join(timeout=20)
+
+
+# ------------------------------------------------- framed socket edges ------
+def _pair():
+    return socket.socketpair()
+
+
+def test_recvall_reassembles_partial_chunked_sends():
+    """Bytes dribbling in across chunk boundaries (three separate sends,
+    paced so each arrives alone) must reassemble into one frame."""
+    a, b = _pair()
+    try:
+        payload = bytes(range(256)) * 20        # 5120 bytes, > chunk size
+        thirds = [payload[:1500], payload[1500:3000], payload[3000:]]
+
+        def dribble():
+            for part in thirds:
+                b.sendall(part)
+                time.sleep(0.02)
+
+        t = threading.Thread(target=dribble, daemon=True)
+        t.start()
+        got = FramedSocket(a).recvall(len(payload))
+        t.join(5)
+        assert got == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recvall_peer_close_mid_frame_raises_connection_error():
+    a, b = _pair()
+    try:
+        b.sendall(b"abc")                       # 3 of 8 promised bytes
+        b.close()
+        with pytest.raises(ConnectionError, match="3/8 bytes"):
+            FramedSocket(a).recvall(8)
+    finally:
+        a.close()
+
+
+@pytest.mark.parametrize("length", [-1, -(2**31), MAX_FRAME + 1, 2**31 - 1])
+def test_recvstr_rejects_hostile_length_prefixes(length):
+    """Negative and oversized length prefixes are protocol violations, not
+    allocation requests or silent empty reads."""
+    a, b = _pair()
+    try:
+        b.sendall(struct.pack("@i", length))
+        with pytest.raises(ProtocolError, match="invalid string length"):
+            FramedSocket(a).recvstr()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recvstr_rejects_non_utf8_payload():
+    a, b = _pair()
+    try:
+        blob = b"\xff\xfe\xfd"
+        b.sendall(struct.pack("@i", len(blob)) + blob)
+        with pytest.raises(ProtocolError, match="non-UTF-8"):
+            FramedSocket(a).recvstr()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recvstr_round_trips_at_boundaries():
+    a, b = _pair()
+    try:
+        fa, fb = FramedSocket(a), FramedSocket(b)
+        for s in ("", "x", "héllo wörld", "a" * 5000):
+            fb.sendstr(s)
+            assert fa.recvstr() == s
+    finally:
+        a.close()
+        b.close()
+
+
+def test_framed_socket_timeout_applies():
+    a, b = _pair()
+    try:
+        fs = FramedSocket(a, timeout=0.1)
+        with pytest.raises(socket.timeout):
+            fs.recvint()                        # nobody ever sends
+    finally:
+        a.close()
+        b.close()
+
+
+# ------------------------------------------------- bind_free_port -----------
+def _spy_sockets(monkeypatch):
+    created = []
+    orig = socket.socket
+
+    def spy(*args, **kwargs):
+        s = orig(*args, **kwargs)
+        created.append(s)
+        return s
+
+    monkeypatch.setattr(socket, "socket", spy)
+    return created
+
+
+def test_bind_free_port_closes_socket_when_range_exhausted(monkeypatch):
+    """Regression: the probe socket used to leak when no free port existed."""
+    created = _spy_sockets(monkeypatch)
+    with pytest.raises(OSError, match="no free port"):
+        bind_free_port("127.0.0.1", 9091, 9091)   # empty range
+    assert created and all(s.fileno() == -1 for s in created)
+
+
+def test_bind_free_port_closes_socket_on_unexpected_bind_error(monkeypatch):
+    """Regression: a non-EADDRINUSE bind error propagated with the socket
+    still open."""
+    import errno
+
+    created = []
+
+    class FailingBind(socket.socket):
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            created.append(self)
+
+        def bind(self, addr):
+            raise OSError(errno.EACCES, "permission denied")
+
+    monkeypatch.setattr(socket, "socket", FailingBind)
+    with pytest.raises(OSError, match="permission denied"):
+        bind_free_port("127.0.0.1", 9091, 9099)
+    assert created and all(s.fileno() == -1 for s in created)
+
+
+def test_bind_free_port_success_transfers_ownership():
+    sock, port = bind_free_port("127.0.0.1", 19900, 19999)
+    try:
+        assert sock.fileno() != -1
+        assert 19900 <= port < 19999
+    finally:
+        sock.close()
+
+
+def test_bind_free_port_skips_busy_ports():
+    taken, port = bind_free_port("127.0.0.1", 19900, 19999)
+    try:
+        sock2, port2 = bind_free_port("127.0.0.1", port, 19999)
+        try:
+            assert port2 > port
+        finally:
+            sock2.close()
+    finally:
+        taken.close()
 
 
 # ------------------------------------------------------------------ opts ----
