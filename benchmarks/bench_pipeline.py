@@ -4,9 +4,17 @@ split_read_test.cc, libsvm_parser_test.cc — they print MB/sec).
 
     python benchmarks/bench_pipeline.py split  <uri> [part] [nparts] [type]
     python benchmarks/bench_pipeline.py parser <uri> [format] [nthread]
+    python benchmarks/bench_pipeline.py parser-ab <uri> [format] [out.json] [workers]
     python benchmarks/bench_pipeline.py gen    <path> [rows] [features] [libsvm|libfm|csv]
     python benchmarks/bench_pipeline.py genrec <path.rec> [records] [bytes]
     python benchmarks/bench_pipeline.py infeed <path.rec> [record_bytes] [batch]
+
+``parser-ab`` is the thread-vs-process A/B behind the pipeline-tuning
+table in docs/performance.md: it drains the same corpus through the
+single-worker, thread-pool, and process-pool (DMLC_PARSE_PROC) backends,
+prints rows/s per stage (raw split read vs parse), and writes the JSON
+record next to the telemetry artifact in CI (and into
+benchmarks/results/ when run by hand).
 """
 
 import os
@@ -41,6 +49,113 @@ def bench_parser(uri, fmt="auto", nthread=2):
         meter.add(0, nrows=block.size)
     meter.add(parser.bytes_read())
     print(f"{rows} rows; {meter.summary()}")
+    print(f"parse-stage: {meter.rows_per_sec:.0f} rows/s")
+
+
+def _drain_parser(uri, fmt, nthread, threaded, env=None):
+    """One timed full drain; returns (rows, bytes, seconds)."""
+    import time as _time
+
+    from dmlc_core_tpu.data.factory import create_parser
+
+    saved = {}
+    for key, value in (env or {}).items():
+        saved[key] = os.environ.get(key)
+        os.environ[key] = value
+    try:
+        parser = create_parser(uri, type=fmt, nthread=nthread,
+                               threaded=threaded)
+        rows = 0
+        t0 = _time.perf_counter()
+        for block in parser:
+            rows += block.size
+        elapsed = _time.perf_counter() - t0
+        nbytes = parser.bytes_read()
+        if hasattr(parser, "close"):
+            parser.close()
+        return rows, nbytes, elapsed
+    finally:
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def bench_parser_ab(uri, fmt="auto", out_json=None, workers=None):
+    """Thread-pool vs process-pool parse A/B with per-stage rows/s."""
+    import json
+    import platform
+    import time as _time
+
+    from dmlc_core_tpu.io.input_split import create_input_split
+
+    nworkers = int(workers) if workers else (os.cpu_count() or 2)
+
+    # stage 0: raw split read (the parse stages sit on top of this)
+    split = create_input_split(uri, 0, 1, "text")
+    t0 = _time.perf_counter()
+    split_bytes = 0
+    while True:
+        chunk = split.next_chunk()
+        if chunk is None:
+            break
+        split_bytes += len(chunk)
+    split_s = _time.perf_counter() - t0
+    split.close()
+
+    configs = {
+        "single": dict(nthread=1, threaded=False,
+                       env={"DMLC_PARSE_PROC": "0"}),
+        f"thread[{nworkers}]": dict(nthread=nworkers, threaded=True,
+                                    env={"DMLC_PARSE_PROC": "0"}),
+        # cold pays the one-per-process worker-pool bring-up inside the
+        # drain; warm reuses the shared pool — the steady-state number
+        f"proc[{nworkers}] cold": dict(nthread=nworkers, threaded=True,
+                                       env={"DMLC_PARSE_PROC": str(nworkers)}),
+        f"proc[{nworkers}] warm": dict(nthread=nworkers, threaded=True,
+                                       env={"DMLC_PARSE_PROC": str(nworkers)}),
+    }
+    results = {"uri": uri, "format": fmt, "workers": nworkers,
+               "host": {"cores": os.cpu_count(),
+                        "python": platform.python_version()},
+               "split_stage": {"bytes": split_bytes, "seconds": split_s,
+                               "mb_per_s": split_bytes / (1 << 20) / max(split_s, 1e-9)},
+               "configs": {}}
+    print(f"split-stage: {results['split_stage']['mb_per_s']:.0f} MB/s raw read")
+    print(f"{'config':>14}  {'rows/s':>10}  {'MB/s':>7}  {'vs single':>9}")
+    base_rps = None
+    for name, cfg in configs.items():
+        rows, nbytes, secs = _drain_parser(uri, fmt, cfg["nthread"],
+                                           cfg["threaded"], cfg["env"])
+        rps = rows / max(secs, 1e-9)
+        if base_rps is None:
+            base_rps = max(rps, 1e-9)
+        is_proc = cfg["env"].get("DMLC_PARSE_PROC", "0") not in ("0", "")
+        engaged = True
+        if is_proc:
+            # the parser falls back to threads when worker bring-up fails
+            # (or the native core disables the backend); a thread number
+            # recorded as "proc" would silently poison the longitudinal
+            # series this JSON exists for
+            from dmlc_core_tpu.data import parse_proc as _pp
+
+            engaged = _pp.engaged()
+        results["configs"][name] = {
+            "rows": rows, "bytes": nbytes, "seconds": secs,
+            "rows_per_s": rps, "mb_per_s": nbytes / (1 << 20) / max(secs, 1e-9),
+            "speedup_vs_single": rps / base_rps,
+            "backend_engaged": engaged,
+        }
+        marker = "" if engaged else "  [FELL BACK TO THREADS]"
+        print(f"{name:>14}  {rps:>10.0f}  "
+              f"{results['configs'][name]['mb_per_s']:>7.1f}  "
+              f"{rps / base_rps:>8.2f}x{marker}")
+    if out_json:
+        with open(out_json, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {out_json}")
+    return results
 
 
 def gen(path, rows=1_000_000, features=28, fmt="libsvm"):
@@ -181,7 +296,8 @@ def main():
         print(__doc__)
         return 2
     cmd, args = sys.argv[1], sys.argv[2:]
-    {"split": bench_split, "parser": bench_parser, "gen": gen,
+    {"split": bench_split, "parser": bench_parser,
+     "parser-ab": bench_parser_ab, "gen": gen,
      "genrec": genrec, "infeed": bench_infeed}[cmd](*args)
     return 0
 

@@ -77,6 +77,10 @@ ALL_RULES = {
         "bare assert validating wire/peer-supplied data in tracker/ or io/ "
         "(vanishes under python -O; crashes the serving thread instead of "
         "rejecting the peer — raise ProtocolError)"),
+    "shm-no-pickle": (
+        "pickle/marshal on the shared-memory parse transport path "
+        "(data/parse_proc.py): array payloads must cross process "
+        "boundaries as raw shm bytes, never pickled objects"),
     "style-no-print": "library code must log via utils.logging, not print()",
 }
 
@@ -256,7 +260,8 @@ def analyze_source(source: str, relpath: str = "<string>",
                         f"syntax error: {exc.msg}")]
     findings: List[Finding] = []
     if is_library:
-        from dmlc_core_tpu.analysis import lockset, protocol, purity, resources
+        from dmlc_core_tpu.analysis import (lockset, protocol, purity,
+                                            resources, transport)
 
         ctx = FileContext(relpath, source, tree, is_library,
                           cli_exempt=relpath in CLI_EXEMPT)
@@ -264,6 +269,7 @@ def analyze_source(source: str, relpath: str = "<string>",
         findings += purity.run(ctx)
         findings += resources.run(ctx)
         findings += protocol.run(ctx)
+        findings += transport.run(ctx)
     supp = suppressed_lines(source)
     findings = [f for f in findings
                 if not ({"all", f.rule} & supp.get(f.lineno, set()))]

@@ -41,6 +41,15 @@ class CSVParser(TextParserBase):
         self.param.init(dict(args or {}), allow_unknown=True)
         CHECK_EQ(self.param.format, "csv")
 
+    def _proc_spec(self):
+        # the process-backend workers rebuild this parser source-less; the
+        # CSV params ride along as URI-style strings (parse_proc)
+        module, qualname, kwargs = super()._proc_spec()
+        kwargs["args"] = {"format": "csv",
+                          "label_column": str(self.param.label_column),
+                          "missing": repr(self.param.missing)}
+        return module, qualname, kwargs
+
     def parse_chunk_native(self, data: bytes):
         from dmlc_core_tpu import native_bridge
 

@@ -3,6 +3,11 @@
 Capability parity with the reference (src/data/libfm_parser.h): feature tokens
 are ``field:index:value`` triples (ParseTriple, strtonum.h:265+); the label
 token may carry a ``:weight``.
+
+Vectorized on the shared byte-level tokenizer (two chained colon-split
+gathers resolve the triples); ``parse_block`` is self-contained, so the
+``DMLC_PARSE_PROC`` process backend can run it in worker processes with
+shared-memory column transport (:mod:`..data.parse_proc`).
 """
 
 from __future__ import annotations

@@ -6,7 +6,11 @@ Capability parity with the reference (src/data/libsvm_parser.h:22-90):
   value vector stays empty when *no* token has a value);
 - empty lines are skipped.
 
-Vectorized: one ``np.char.partition`` + bulk ``astype`` per chunk sub-range.
+Vectorized: whole-chunk byte-array tokenization + one colon-split gather +
+bulk ``astype`` per chunk sub-range (:mod:`dmlc_core_tpu.data.text_np`).
+``parse_block`` is self-contained (no source, no pools), which is what lets
+the ``DMLC_PARSE_PROC`` process backend run it inside worker processes and
+ship the columns back through shared memory (:mod:`..data.parse_proc`).
 """
 
 from __future__ import annotations

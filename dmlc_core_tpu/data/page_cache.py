@@ -1,0 +1,262 @@
+"""Columnar page cache v2: raw little-endian column buffers + mmap replay.
+
+The v1 cache (``RowBlockContainer.save`` framing, reference
+row_block.h:181-205) re-deserializes every page each epoch: every
+``load`` is read -> frombuffer -> copy.  v2 lays pages out so that a later
+epoch is *one mmap and zero copies*:
+
+- **file header** (32 B): magic ``DMLCRBC2``, version, the index dtype the
+  cache was built with, reserved;
+- **pages**: an 80 B checksummed page header (page magic, a CRC32 covering
+  the header's own size/count/max fields *and* the payload, payload size,
+  six column element counts, max_field/max_index) followed by the six
+  column buffers — offset ``int64``, label/weight/value ``float32``,
+  field/index in the header dtype — each padded to 8-byte alignment so
+  every ``np.frombuffer`` lands aligned;
+- **footer**: a TOC (page count + page byte offsets) and a fixed 24 B tail
+  (TOC offset, CRC32 of the TOC, magic ``DMLCRBE2``) written *last* — a
+  build that died mid-write has no tail and is rejected as
+  :class:`CacheFormatError`, never silently truncated data.
+
+Builds are atomic: :class:`PageCacheWriter` writes to a temp file in the
+cache's directory, fsyncs, and ``os.replace``s into place on
+:meth:`commit` (plus a directory fsync so the rename itself is durable).
+
+:class:`PageCacheReader` validates magic/version/dtype/TOC and every page
+CRC once, then hands out RowBlocks whose arrays are read-only views into
+the mapping — the same objects every epoch, which is what makes epoch>=2
+zero-copy by construction.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import zlib
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.data.row_block import (COLUMN_ORDER, RowBlock,
+                                          RowBlockContainer, align8)
+
+__all__ = ["PageCacheWriter", "PageCacheReader", "CacheFormatError",
+           "HEAD_MAGIC"]
+
+HEAD_MAGIC = b"DMLCRBC2"
+TAIL_MAGIC = b"DMLCRBE2"
+VERSION = 2
+_PAGE_MAGIC = 0x32474150  # "PAG2", little-endian
+
+_HEAD = struct.Struct("<8sI4s16x")          # magic, version, dtype str
+_PAGE_HEAD = struct.Struct("<IIQ6Q2Q")  # magic, crc, payload, counts[6], maxes
+# the page CRC covers the header fields after the CRC itself (payload size,
+# column counts, maxes) AND the payload: a corrupted count is as fatal as a
+# corrupted byte — it re-slices every column
+_PAGE_META = struct.Struct("<Q6Q2Q")
+_TAIL = struct.Struct("<QI4x8s")            # toc offset, toc crc, magic
+
+# column layout order shared with the shm transport (row_block.COLUMN_ORDER);
+# (real) dtypes resolved per cache index dtype
+_COL_ORDER = COLUMN_ORDER
+_align8 = align8
+
+
+class CacheFormatError(RuntimeError):
+    """A cache file that cannot be trusted (truncated, corrupt, or built
+    with different parameters) — callers rebuild or abort loudly."""
+
+
+def _dtype_tag(index_dtype: np.dtype) -> bytes:
+    tag = np.dtype(index_dtype).newbyteorder("<").str.encode()
+    return tag.ljust(4, b"\0")
+
+
+class PageCacheWriter:
+    """Atomic v2 cache build: temp file -> fsync -> rename on commit."""
+
+    def __init__(self, path: str, index_dtype=np.uint32):
+        self._path = path
+        self._index_dtype = np.dtype(index_dtype)
+        self._tmp = f"{path}.build-{os.getpid()}.tmp"
+        self._fo = open(self._tmp, "wb")
+        self._page_offsets: List[int] = []
+        self._pos = 0
+        self._write(_HEAD.pack(HEAD_MAGIC, VERSION,
+                               _dtype_tag(self._index_dtype)))
+        self.pages_written = 0
+
+    def _write(self, data: bytes) -> None:
+        self._fo.write(data)
+        self._pos += len(data)
+
+    def _col_arrays(self, block: RowBlock) -> List[np.ndarray]:
+        idx = self._index_dtype
+        empty = np.empty(0, np.float32)
+        return [
+            np.ascontiguousarray(block.offset, dtype=np.int64),
+            np.ascontiguousarray(block.label, dtype=np.float32),
+            (np.ascontiguousarray(block.weight, dtype=np.float32)
+             if block.weight is not None else empty),
+            (np.ascontiguousarray(block.field, dtype=idx)
+             if block.field is not None else np.empty(0, idx)),
+            np.ascontiguousarray(block.index, dtype=idx),
+            (np.ascontiguousarray(block.value, dtype=np.float32)
+             if block.value is not None else empty),
+        ]
+
+    def write_page(self, container: RowBlockContainer) -> None:
+        """Serialize one page (a RowBlockContainer worth of rows)."""
+        block = container.get_block()
+        cols = self._col_arrays(block)
+        payload = bytearray()
+        for arr in cols:
+            raw = arr.astype(arr.dtype.newbyteorder("<"), copy=False).tobytes()
+            payload += raw
+            payload += b"\0" * (_align8(len(raw)) - len(raw))
+        nnz = block.num_nonzero
+        max_field = container.max_field or (
+            int(block.field.max()) if block.field is not None and nnz else 0)
+        max_index = container.max_index or (
+            int(block.index.max()) if nnz else 0)
+        meta = _PAGE_META.pack(len(payload), *(len(c) for c in cols),
+                               max_field, max_index)
+        payload = bytes(payload)
+        crc = zlib.crc32(payload, zlib.crc32(meta))
+        self._page_offsets.append(self._pos)
+        self._write(struct.pack("<II", _PAGE_MAGIC, crc) + meta)
+        self._write(payload)
+        self.pages_written += 1
+        telemetry.count("dmlc_cache_pages_written_total")
+
+    def commit(self) -> None:
+        """Write TOC + tail, fsync, and atomically move into place."""
+        toc = struct.pack("<Q", len(self._page_offsets))
+        toc += struct.pack(f"<{len(self._page_offsets)}Q",
+                           *self._page_offsets)
+        toc_offset = self._pos
+        self._write(toc)
+        self._write(_TAIL.pack(toc_offset, zlib.crc32(toc), TAIL_MAGIC))
+        self._fo.flush()
+        os.fsync(self._fo.fileno())
+        self._fo.close()
+        os.replace(self._tmp, self._path)
+        # the rename must survive a crash too, not just the data
+        dir_fd = os.open(os.path.dirname(os.path.abspath(self._path)),
+                         os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+
+    def abort(self) -> None:
+        """Drop the partial build; the real cache path is untouched."""
+        try:
+            self._fo.close()
+        finally:
+            if os.path.exists(self._tmp):
+                os.unlink(self._tmp)
+
+
+class PageCacheReader:
+    """Validate + mmap a v2 cache; serve zero-copy RowBlocks per page."""
+
+    def __init__(self, path: str, index_dtype=np.uint32):
+        self._path = path
+        self._index_dtype = np.dtype(index_dtype)
+        size = os.path.getsize(path)
+        if size < _HEAD.size + _TAIL.size:
+            raise CacheFormatError(f"{path}: too small for a v2 cache "
+                                   f"({size} bytes)")
+        self._fd = open(path, "rb")
+        self._mm = mmap.mmap(self._fd.fileno(), 0, access=mmap.ACCESS_READ)
+        try:
+            self._pages = self._load_pages(size)
+        except Exception:
+            self.close()
+            raise
+        self.blocks: List[RowBlock] = [p for p in self._pages]
+
+    def _load_pages(self, size: int) -> List[RowBlock]:
+        mm = self._mm
+        magic, version, dtype_tag = _HEAD.unpack(mm[:_HEAD.size])
+        if magic != HEAD_MAGIC:
+            raise CacheFormatError(f"{self._path}: not a v2 cache")
+        if version != VERSION:
+            raise CacheFormatError(
+                f"{self._path}: cache version {version} != {VERSION}")
+        want = _dtype_tag(self._index_dtype)
+        if dtype_tag != want:
+            have_s = dtype_tag.rstrip(b"\0").decode(errors="replace")
+            want_s = want.rstrip(b"\0").decode(errors="replace")
+            raise CacheFormatError(
+                f"{self._path}: cache index dtype {have_s!r} != "
+                f"requested {want_s!r}")
+        toc_offset, toc_crc, tail_magic = _TAIL.unpack(mm[size - _TAIL.size:])
+        if tail_magic != TAIL_MAGIC:
+            raise CacheFormatError(
+                f"{self._path}: missing footer (interrupted build or "
+                "truncated file)")
+        if not _HEAD.size <= toc_offset <= size - _TAIL.size - 8:
+            raise CacheFormatError(f"{self._path}: TOC offset out of range")
+        toc = bytes(mm[toc_offset:size - _TAIL.size])
+        if zlib.crc32(toc) != toc_crc:
+            raise CacheFormatError(f"{self._path}: TOC checksum mismatch")
+        (npages,) = struct.unpack_from("<Q", toc, 0)
+        if len(toc) != 8 + 8 * npages:
+            raise CacheFormatError(f"{self._path}: TOC size mismatch")
+        offsets = struct.unpack_from(f"<{npages}Q", toc, 8)
+        return [self._load_page(off, toc_offset) for off in offsets]
+
+    def _wrap(self, off: int, count: int, dtype) -> Optional[np.ndarray]:
+        if count == 0:
+            return None
+        return np.frombuffer(self._mm, dtype=dtype, count=count, offset=off)
+
+    def _load_page(self, off: int, limit: int) -> RowBlock:
+        mm = self._mm
+        if off + _PAGE_HEAD.size > limit:
+            raise CacheFormatError(f"{self._path}: page header out of range")
+        fields = _PAGE_HEAD.unpack(mm[off:off + _PAGE_HEAD.size])
+        magic, crc, payload_bytes = fields[0], fields[1], fields[2]
+        counts = fields[3:9]
+        if magic != _PAGE_MAGIC:
+            raise CacheFormatError(f"{self._path}: bad page magic at {off}")
+        start = off + _PAGE_HEAD.size
+        if start + payload_bytes > limit:
+            raise CacheFormatError(f"{self._path}: page payload truncated")
+        meta = mm[off + 8:off + _PAGE_HEAD.size]
+        if zlib.crc32(mm[start:start + payload_bytes],
+                      zlib.crc32(meta)) != crc:
+            raise CacheFormatError(
+                f"{self._path}: page checksum mismatch at {off}")
+        idx = self._index_dtype
+        dtypes = (np.dtype(np.int64), np.dtype(np.float32),
+                  np.dtype(np.float32), idx, idx, np.dtype(np.float32))
+        if sum(_align8(count * dtype.itemsize)
+               for count, dtype in zip(counts, dtypes)) != payload_bytes:
+            # CRC makes this unreachable short of a collision, but a
+            # mis-sliced column must surface as a cache error, never as a
+            # frombuffer ValueError outside the rebuild path
+            raise CacheFormatError(
+                f"{self._path}: column counts disagree with payload size")
+        views = []
+        pos = start
+        for count, dtype in zip(counts, dtypes):
+            nbytes = count * dtype.itemsize
+            views.append(self._wrap(pos, count, dtype))
+            pos += _align8(nbytes)
+        offset, label, weight, field, index, value = views
+        return RowBlock(offset, label,
+                        index if index is not None else np.empty(0, idx),
+                        value, weight, field)
+
+    def close(self) -> None:
+        """Best-effort unmap; live views keep the mapping alive via GC."""
+        try:
+            self._mm.close()
+        except BufferError:
+            pass  # exported RowBlock views still hold pointers
+        self._fd.close()

@@ -10,23 +10,50 @@ and ``TextParserBase`` (src/data/text_parser.h:24-118):
 - :class:`TextParserBase` — one InputSplit chunk is cut into per-worker
   sub-ranges realigned at newlines and parsed in parallel (FillData,
   text_parser.h:89-118); workers run in a thread pool (the reference's OpenMP
-  team) and the heavy lifting is vectorized numpy, which releases the GIL;
+  team) and the heavy lifting is vectorized numpy, which releases the GIL.
+  With ``DMLC_PARSE_PROC=N`` the fan-out moves to worker *processes* whose
+  RowBlock columns come back through shared memory with zero copies
+  (:mod:`dmlc_core_tpu.data.parse_proc`) — auto-off when the native core
+  parses chunks itself, with a clean fallback to the thread path;
 - :class:`ThreadedParser` — prefetch decorator running the whole parse on a
-  producer thread with a bounded queue (parser.h:70-126, capacity 8).
+  producer thread with a bounded queue (parser.h:70-126, capacity 8); the
+  queue is additionally bounded by decoded-block *bytes*
+  (``DMLC_PARSE_QUEUE_BYTES``, default 256 MiB), since 8 blocks of wide CSV
+  can dwarf 8 blocks of sparse libsvm.
 """
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
+import numpy as np
+
 from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.data import parse_proc
 from dmlc_core_tpu.data.row_block import RowBlock, RowBlockContainer, concat_blocks
 from dmlc_core_tpu.io.input_split import InputSplit
 from dmlc_core_tpu.io.threadediter import ThreadedIter
-from dmlc_core_tpu.utils.logging import CHECK
+from dmlc_core_tpu.utils.logging import CHECK, log_warning
 
 __all__ = ["Parser", "ParserImpl", "TextParserBase", "ThreadedParser"]
+
+DEFAULT_PARSE_QUEUE_BYTES = 256 << 20
+
+
+def _parse_queue_bytes() -> Optional[int]:
+    """DMLC_PARSE_QUEUE_BYTES: decoded-bytes bound for the parse prefetch
+    queue (<=0 disables the byte bound; item-count capacity still applies)."""
+    raw = os.environ.get("DMLC_PARSE_QUEUE_BYTES", "").strip()
+    if not raw:
+        return DEFAULT_PARSE_QUEUE_BYTES
+    try:
+        value = int(raw)
+    except ValueError:
+        log_warning(f"ignoring non-integer DMLC_PARSE_QUEUE_BYTES={raw!r}")
+        return DEFAULT_PARSE_QUEUE_BYTES
+    return value if value > 0 else None
 
 
 class Parser:
@@ -65,6 +92,9 @@ class ParserImpl(Parser):
         while self._pos >= len(self._blocks):
             containers = self.parse_next_blocks()
             if containers is None:
+                # drop the last chunk's blocks at EOF: with the shm
+                # transport each retained block pins a segment lease
+                self._blocks, self._pos = [], 0
                 return None
             self._blocks = [c.get_block() for c in containers if c.size > 0]
             self._pos = 0
@@ -84,6 +114,9 @@ class TextParserBase(ParserImpl):
         self._pool = (ThreadPoolExecutor(max_workers=self._nthread,
                                          thread_name_prefix="dmlc-parse")
                       if self._nthread > 1 else None)
+        self._nproc = parse_proc.resolve_nproc()
+        self._proc_pool: Optional[parse_proc.ProcParsePool] = None
+        self._proc_off = self._nproc < 2
 
     def before_first(self) -> None:
         self._source.before_first()
@@ -101,6 +134,40 @@ class TextParserBase(ParserImpl):
         None to fall back to the numpy path.  The native parser threads
         internally (the reference's OpenMP team, text_parser.h:100-115)."""
         return None
+
+    def _proc_spec(self):
+        """``(module, class, kwargs)`` rebuilding a source-less, thread-less
+        twin of this parser inside each worker process.  Subclasses whose
+        constructor takes extra state (CSV args) extend the kwargs."""
+        idx = np.dtype(getattr(self, "_index_dtype", np.uint32))
+        return (type(self).__module__, type(self).__qualname__,
+                {"nthread": 1, "index_dtype": idx.str})
+
+    def _get_proc_pool(self) -> Optional[parse_proc.ProcParsePool]:
+        """The lazy process pool, or None (off / native core / failed)."""
+        if self._proc_off:
+            return None
+        if self._proc_pool is not None and not self._proc_pool.alive():
+            # the shared pool this handle was built on died (worker kill):
+            # drop the handle so a retried epoch self-heals on a fresh pool
+            self._proc_pool = None
+        if self._proc_pool is None:
+            from dmlc_core_tpu import native_bridge
+
+            if native_bridge.available():
+                # the native parser threads internally without the GIL;
+                # stacking processes on top only costs transport
+                self._proc_off = True
+                return None
+            try:
+                self._proc_pool = parse_proc.ProcParsePool(
+                    self._proc_spec(), self._nproc)
+            except Exception as exc:  # noqa: BLE001 - any bring-up failure
+                log_warning("process parse backend unavailable "
+                            f"({exc!r}); falling back to threads")
+                self._proc_off = True
+                return None
+        return self._proc_pool
 
     def parse_next_blocks(self) -> Optional[List[RowBlockContainer]]:
         """One source chunk -> containers, with per-chunk telemetry (span +
@@ -149,7 +216,11 @@ class TextParserBase(ParserImpl):
             native = self.parse_chunk_native(chunk)
             if native is not None:
                 return [native]
-        ranges = self._split_ranges(chunk, self._nthread)
+        pool = self._get_proc_pool()
+        ranges = self._split_ranges(chunk, pool.nproc if pool is not None
+                                    else self._nthread)
+        if pool is not None and len(ranges) > 1:
+            return pool.parse_ranges(ranges, parser_name=type(self).__name__)
         if self._pool is None or len(ranges) <= 1:
             return [self.parse_block(r) for r in ranges]
         return list(self._pool.map(self.parse_block, ranges))
@@ -180,6 +251,9 @@ class TextParserBase(ParserImpl):
         return ranges
 
     def close(self) -> None:
+        if self._proc_pool is not None:
+            self._proc_pool.close()
+            self._proc_pool = None
         if self._pool is not None:
             self._pool.shutdown(wait=False)
         self._source.close()
@@ -199,12 +273,23 @@ class _ParseProducer:
 
 class ThreadedParser(Parser):
     """Prefetch decorator: parsing runs on a producer thread
-    (reference ThreadedParser, parser.h:70-126, queue capacity 8)."""
+    (reference ThreadedParser, parser.h:70-126, queue capacity 8).
 
-    def __init__(self, base: ParserImpl, max_capacity: int = 8):
+    The queue is bounded both by item count and by decoded-block bytes
+    (``max_bytes``, default from ``DMLC_PARSE_QUEUE_BYTES``): 8 queued
+    blocks is ~8x chunk_size x fan-out of decoded arrays, which for wide
+    rows can be gigabytes — the byte bound keeps prefetch memory flat
+    regardless of row shape."""
+
+    def __init__(self, base: ParserImpl, max_capacity: int = 8,
+                 max_bytes: Optional[int] = None):
         self._base = base
+        if max_bytes is None:
+            max_bytes = _parse_queue_bytes()
         self._iter = ThreadedIter(_ParseProducer(base),
-                                  max_capacity=max_capacity, name="parse")
+                                  max_capacity=max_capacity, name="parse",
+                                  max_bytes=max_bytes,
+                                  cost_fn=RowBlock.memory_cost_bytes)
 
     def before_first(self) -> None:
         self._iter.before_first()

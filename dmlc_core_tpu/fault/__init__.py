@@ -17,7 +17,9 @@ Injection sites (see :data:`SITES`):
   (``http_status`` rules replace the request; act rules fire before it);
 - ``io.stream.open``       — URI stream factory open;
 - ``io.stream.read``       — :meth:`Stream.read_exact` (``truncate`` rules);
-- ``threadediter.produce`` — the producer thread, per item.
+- ``threadediter.produce`` — the producer thread, per item;
+- ``data.parse_worker``    — process-pool parse workers, per sub-range
+  (``exit`` = kill a worker mid-chunk).
 
 **Disabled is the default and costs one attribute load + branch**: every
 helper returns immediately while no plan is configured, and the instrumented
@@ -78,6 +80,11 @@ SITES: Dict[str, str] = {
         "truncated object/dropped connection"),
     "threadediter.produce": (
         "producer thread, once per produced item (ctx: name=<iterator>)"),
+    "data.parse_worker": (
+        "process-pool parse worker, once per sub-range before parsing "
+        "(ctx: parser=<class>); 'exit' kills the worker mid-chunk.  "
+        "Workers read DMLC_FAULT_PLAN at start: the shared pool must be "
+        "(re)started after setting the plan (data.parse_proc.shutdown())"),
 }
 
 _plan: Optional[FaultPlan] = None

@@ -64,22 +64,35 @@ class IteratorProducer:
 class ThreadedIter(Generic[T]):
     """Single-producer bounded-queue prefetch iterator.
 
-    Observability: :meth:`qsize` reports current queue occupancy;
-    ``producer_stalls`` / ``consumer_stalls`` count wait *episodes* (a
-    producer blocked on a full queue / a consumer blocked on an empty one —
-    each stall names the side that is the bottleneck); the optional
-    ``on_producer_stall`` / ``on_consumer_stall`` hooks fire once per
-    episode (called under the iterator lock: keep them cheap and never
-    call back into the iterator).  With telemetry enabled the same signals
-    feed the ``dmlc_threadediter_*`` metric families, labeled by ``name``.
+    Capacity is bounded by item count (``max_capacity``) and, when a
+    ``cost_fn`` is given, by total queued cost (``max_bytes``): the producer
+    blocks while ``sum(cost_fn(item))`` of queued items is at or over the
+    bound.  At least one item is always admitted, so a single over-budget
+    item flows instead of deadlocking.  The bound is checked *before*
+    producing — the queue can overshoot by at most one item.
+
+    Observability: :meth:`qsize` reports current queue occupancy (and
+    :meth:`qbytes` the queued cost); ``producer_stalls`` /
+    ``consumer_stalls`` count wait *episodes* (a producer blocked on a full
+    queue / a consumer blocked on an empty one — each stall names the side
+    that is the bottleneck); the optional ``on_producer_stall`` /
+    ``on_consumer_stall`` hooks fire once per episode (called under the
+    iterator lock: keep them cheap and never call back into the iterator).
+    With telemetry enabled the same signals feed the
+    ``dmlc_threadediter_*`` metric families, labeled by ``name``.
     """
 
     def __init__(self, producer: Any = None, max_capacity: int = 8,
-                 name: str = "threadediter"):
+                 name: str = "threadediter",
+                 max_bytes: Optional[int] = None,
+                 cost_fn: Optional[Callable[[Any], int]] = None):
         self._cap = max(1, int(max_capacity))
         self._name = name
+        self._max_bytes = int(max_bytes) if max_bytes else None
+        self._cost_fn = cost_fn
+        self._queue_bytes = 0             # summed cost of queued items
         self._cond = threading.Condition()
-        self._queue: deque = deque()      # (generation, item-or-_END)
+        self._queue: deque = deque()      # (generation, item-or-_END, cost)
         self._free: deque = deque()       # recycled buffers
         self._gen = 0                     # current consumer generation
         self._destroyed = False
@@ -107,15 +120,29 @@ class ThreadedIter(Generic[T]):
         with self._cond:
             return self._qsize_locked()
 
+    def qbytes(self) -> int:
+        """Summed ``cost_fn`` cost of queued items (0 without a cost_fn)."""
+        with self._cond:
+            return self._queue_bytes
+
     def _qsize_locked(self) -> int:
-        return sum(1 for gen, item in self._queue
+        return sum(1 for gen, item, _ in self._queue
                    if gen == self._gen and item is not _END)
+
+    def _full_locked(self) -> bool:
+        if len(self._queue) >= self._cap:
+            return True
+        return (self._max_bytes is not None and len(self._queue) > 0
+                and self._queue_bytes >= self._max_bytes)
 
     def _note_depth_locked(self) -> None:
         try:
             if telemetry.enabled():
                 telemetry.gauge_set("dmlc_threadediter_queue_depth",
                                     self._qsize_locked(), name=self._name)
+                if self._cost_fn is not None:
+                    telemetry.gauge_set("dmlc_threadediter_queue_bytes",
+                                        self._queue_bytes, name=self._name)
         except Exception:
             # observability must never kill the producer thread (a dead
             # producer with no _error/_END posted hangs next() forever)
@@ -188,11 +215,11 @@ class ThreadedIter(Generic[T]):
         """Produce items for ``cur_gen`` until EOF/reset. None means destroyed."""
         while True:
             with self._cond:
-                if (len(self._queue) >= self._cap and not self._destroyed
+                if (self._full_locked() and not self._destroyed
                         and self._gen == cur_gen):
                     # queue full: the consumer is the bottleneck right now
                     self._note_producer_stall_locked()
-                while (len(self._queue) >= self._cap and not self._destroyed
+                while (self._full_locked() and not self._destroyed
                        and self._gen == cur_gen):
                     self._cond.wait()
                 if self._destroyed:
@@ -215,6 +242,12 @@ class ThreadedIter(Generic[T]):
                         self._free.append(reuse)
                 self._post_error(cur_gen, exc)
                 return True  # epoch over; stay alive for a restart
+            cost = 0
+            if item is not None and self._cost_fn is not None:
+                try:
+                    cost = max(0, int(self._cost_fn(item)))
+                except Exception:
+                    logger.exception("cost hook failed; item costed as 0")
             with self._cond:
                 if self._destroyed:
                     return None
@@ -227,7 +260,9 @@ class ThreadedIter(Generic[T]):
                     if reuse is not None:
                         self._free.append(reuse)
                     return True
-                self._queue.append((cur_gen, _END if item is None else item))
+                self._queue.append((cur_gen, _END if item is None else item,
+                                    cost))
+                self._queue_bytes += cost
                 self._note_depth_locked()
                 self._cond.notify_all()
                 if item is None:
@@ -244,7 +279,7 @@ class ThreadedIter(Generic[T]):
                 # would make an otherwise-successful restart raise at EOF
                 return
             self._error = exc
-            self._queue.append((gen, _END))
+            self._queue.append((gen, _END, 0))
             self._cond.notify_all()
 
     # -- consumer side ---------------------------------------------------------
@@ -257,12 +292,13 @@ class ThreadedIter(Generic[T]):
                     return None
                 # drop items from stale generations, recycling their buffers
                 while self._queue and self._queue[0][0] != self._gen:
-                    _, item = self._queue.popleft()
+                    _, item, cost = self._queue.popleft()
+                    self._queue_bytes -= cost
                     if item is not _END:
                         self._free.append(item)
                     self._cond.notify_all()
                 if self._queue:
-                    gen, item = self._queue[0]
+                    gen, item, cost = self._queue[0]
                     if item is _END:
                         if self._error is not None:
                             err, self._error = self._error, None
@@ -273,6 +309,7 @@ class ThreadedIter(Generic[T]):
                             raise err
                         return None  # leave _END queued: epoch stays "ended"
                     self._queue.popleft()
+                    self._queue_bytes -= cost
                     self._note_depth_locked()
                     self._cond.notify_all()
                     return item
@@ -299,9 +336,10 @@ class ThreadedIter(Generic[T]):
             self._error = None
             # drop everything already queued
             while self._queue:
-                _, item = self._queue.popleft()
+                _, item, _ = self._queue.popleft()
                 if item is not _END:
                     self._free.append(item)
+            self._queue_bytes = 0
             self._note_depth_locked()
             self._cond.notify_all()
 

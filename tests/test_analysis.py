@@ -908,3 +908,82 @@ def test_lint_shim_delegates_to_analyzer(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "dmlclint" in proc.stdout
+
+
+# -- pass 5: transport (shm-no-pickle) ---------------------------------------
+
+SHM_PATH = "dmlc_core_tpu/data/parse_proc.py"
+
+
+def test_shm_no_pickle_flags_import_and_call():
+    src = """
+    import pickle
+
+    def ship(payload):
+        return pickle.dumps(payload)
+    """
+    found = rules_of(src, SHM_PATH)
+    assert found.count("shm-no-pickle") == 2  # the import and the call
+
+
+def test_shm_no_pickle_flags_aliased_and_from_imports():
+    src = """
+    import pickle as pkl
+    from multiprocessing.reduction import ForkingPickler
+
+    def ship(payload):
+        return pkl.loads(payload)
+
+    def ship2(payload, fd):
+        ForkingPickler(fd).dump(payload)
+    """
+    found = rules_of(src, SHM_PATH)
+    assert found.count("shm-no-pickle") == 4
+
+
+def test_shm_no_pickle_flags_serializer_cousins():
+    src = """
+    import marshal
+
+    def ship(payload):
+        return marshal.dumps(payload)
+    """
+    assert "shm-no-pickle" in rules_of(src, SHM_PATH)
+
+
+def test_shm_no_pickle_scoped_to_transport_module():
+    src = """
+    import pickle
+
+    def elsewhere(payload):
+        return pickle.dumps(payload)
+    """
+    assert "shm-no-pickle" not in rules_of(src, "dmlc_core_tpu/data/other.py")
+    assert "shm-no-pickle" not in rules_of(src, "dmlc_core_tpu/serializer.py")
+
+
+def test_shm_no_pickle_clean_transport_module_passes():
+    src = """
+    import numpy as np
+
+    def ship(shm, arr):
+        np.frombuffer(shm.buf, np.uint8, arr.nbytes)[:] = arr.view(np.uint8)
+    """
+    assert "shm-no-pickle" not in rules_of(src, SHM_PATH)
+
+
+def test_shm_no_pickle_suppressible_like_any_rule():
+    src = """
+    import pickle  # dmlclint: disable=shm-no-pickle
+
+    def meta_only():
+        return None
+    """
+    assert "shm-no-pickle" not in rules_of(src, SHM_PATH)
+
+
+def test_real_parse_proc_module_is_clean():
+    path = os.path.join(REPO, "dmlc_core_tpu", "data", "parse_proc.py")
+    with open(path, encoding="utf-8") as f:
+        found = [x.rule for x in analyze_source(f.read(), SHM_PATH)]
+    assert "shm-no-pickle" not in found

@@ -1,0 +1,320 @@
+"""Differential + chaos coverage for the parse fan-out rebuild (ISSUE 5):
+
+- the vectorized tokenizer vs a straightforward per-line reference
+  implementation, over generated corpora with CRLF line ends, empty lines,
+  colon-in-token shapes, and garbage;
+- the process backend (`DMLC_PARSE_PROC`) vs the thread pool vs the serial
+  path: byte-identical RowBlocks across csv/libsvm/libfm;
+- chaos: a parse worker killed mid-chunk surfaces a clean error on the
+  consumer (never a hang), driven through the ``data.parse_worker`` fault
+  site.
+"""
+
+import os
+import random
+from itertools import chain
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data import parse_proc, text_np
+from dmlc_core_tpu.data.factory import create_parser
+
+
+# -- reference (naive) tokenizer implementations ------------------------------
+
+def naive_tokenize(data):
+    tok_lists = [l.split() for l in data.splitlines()]
+    tok_lists = [t for t in tok_lists if t]
+    if not tok_lists:
+        return np.empty(0, dtype="S1"), np.empty(0, dtype=np.int64)
+    counts = np.fromiter((len(t) for t in tok_lists), np.int64, len(tok_lists))
+    return np.array(list(chain.from_iterable(tok_lists))), counts
+
+
+def naive_split(tokens):
+    parts = [bytes(t).partition(b":") for t in tokens]
+    return ([h for h, _, _ in parts], [s == b":" for _, s, _ in parts],
+            [t for _, _, t in parts])
+
+
+def corpus_cases():
+    rng = random.Random(42)
+    cases = [
+        b"",
+        b"\n\r\n\r\r\n",
+        b"   \t \v \f  \n",
+        b"1 0:1.5 3:2.0\r\n0 1:1.0\r\n1\r\n",      # CRLF + featureless row
+        b"1:2:3 :lead trail: :: a:b:c\n",           # colon-in-token shapes
+        b"x",                                        # no trailing newline
+        b"a" * 400 + b" end\n",                      # beyond the gather width
+        bytes(rng.getrandbits(7) for _ in range(512)),  # printable-ish noise
+    ]
+    for _ in range(40):
+        parts = []
+        for _ in range(rng.randint(0, 40)):
+            if rng.random() < 0.25:
+                parts.append(rng.choice(
+                    [b"\n", b"\r\n", b"\r", b" ", b"\t", b"\v", b"\f"]))
+            else:
+                parts.append(bytes(rng.choice(b"abz0123456789.:-+e")
+                                   for _ in range(rng.randint(1, 14))))
+                parts.append(rng.choice([b" ", b"\n", b"\r\n", b"\t", b""]))
+        cases.append(b"".join(parts))
+    return cases
+
+
+def test_vectorized_tokenizer_matches_reference():
+    for data in corpus_cases():
+        ref_toks, ref_counts = naive_tokenize(data)
+        toks, counts = text_np.tokenize_ws(data)
+        assert [bytes(t) for t in toks] == [bytes(t) for t in ref_toks], data
+        assert counts.tolist() == ref_counts.tolist(), data
+        assert int(counts.sum()) == len(toks)
+
+
+def test_vectorized_colon_split_matches_reference():
+    for data in corpus_cases():
+        toks, _ = text_np.tokenize_ws(data)
+        if toks.size == 0:
+            continue
+        head, has, tail = text_np.split_tokens_at_colon(toks)
+        rh, rhas, rt = naive_split(toks)
+        assert [bytes(h) for h in head] == rh, data
+        assert has.tolist() == rhas, data
+        assert [bytes(t) for t in tail] == rt, data
+
+
+def test_tokenizer_empty_and_all_whitespace():
+    for data in (b"", b"\n", b" \t ", b"\r\n\r\n"):
+        toks, counts = text_np.tokenize_ws(data)
+        assert toks.size == 0 and counts.size == 0
+
+
+# -- backend differential: serial vs threads vs processes ---------------------
+
+def _gen_corpus(tmp_path, fmt, rows=4000):
+    rng = np.random.RandomState(7)
+    lines = []
+    for i in range(rows):
+        if i % 61 == 0:
+            lines.append("")                        # empty line
+        feats = sorted(rng.choice(60, size=rng.randint(1, 8), replace=False))
+        if fmt == "csv":
+            lines.append(",".join(f"{rng.randn():.4f}" for _ in range(6)))
+        elif fmt == "libfm":
+            lines.append(f"{i % 2} " + " ".join(
+                f"{j % 5}:{j}:{rng.rand():.4f}" for j in feats))
+        else:
+            lines.append(f"{i % 2} " + " ".join(
+                f"{j}:{rng.rand():.4f}" for j in feats))
+    eol = "\r\n" if fmt == "libsvm" else "\n"       # CRLF coverage
+    path = tmp_path / f"corpus.{fmt}"
+    path.write_bytes((eol.join(lines) + eol).encode())
+    return str(path)
+
+
+def _blocks_concat(parser):
+    blocks = list(parser)
+    if hasattr(parser, "close"):
+        parser.close()
+    out = {}
+    for att in ("label", "index", "value", "weight", "field", "offset"):
+        cols = [getattr(b, att) for b in blocks]
+        if any(c is None for c in cols):
+            assert all(c is None for c in cols) or att in ("value", "weight",
+                                                           "field")
+            cols = [c for c in cols if c is not None]
+        out[att] = np.concatenate(cols) if cols else None
+    out["rows"] = sum(b.size for b in blocks)
+    return out
+
+
+@pytest.mark.parametrize("fmt", ["libsvm", "libfm", "csv"])
+def test_proc_thread_serial_blocks_identical(tmp_path, monkeypatch, fmt):
+    uri = _gen_corpus(tmp_path, fmt)
+    monkeypatch.setenv("DMLC_PARSE_PROC", "0")
+    serial = _blocks_concat(create_parser(uri, type=fmt, nthread=1,
+                                          threaded=False))
+    threaded = _blocks_concat(create_parser(uri, type=fmt, nthread=3,
+                                            threaded=True))
+    monkeypatch.setenv("DMLC_PARSE_PROC", "2")
+    proc = _blocks_concat(create_parser(uri, type=fmt, nthread=2,
+                                        threaded=True))
+    assert serial["rows"] == threaded["rows"] == proc["rows"] > 0
+    for att in ("label", "index", "value", "weight", "field"):
+        for other in (threaded, proc):
+            if serial[att] is None:
+                assert other[att] is None
+            else:
+                np.testing.assert_array_equal(serial[att], other[att])
+
+
+def test_proc_backend_invalid_env_falls_back(tmp_path, monkeypatch):
+    uri = _gen_corpus(tmp_path, "libsvm", rows=100)
+    monkeypatch.setenv("DMLC_PARSE_PROC", "not-a-number")
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    assert sum(b.size for b in parser) == 100
+    parser.close()
+
+
+def test_proc_backend_bad_error_consistency(tmp_path, monkeypatch):
+    """Garbage input raises the same ValueError class through every
+    backend — not a hang, not a BrokenProcessPool."""
+    path = tmp_path / "bad.libsvm"
+    path.write_bytes(b"1 abc:def\n" * 50)
+    monkeypatch.setenv("DMLC_PARSE_PROC", "0")
+    with pytest.raises(ValueError, match="feature"):
+        list(create_parser(str(path), type="libsvm", threaded=False))
+    monkeypatch.setenv("DMLC_PARSE_PROC", "2")
+    parser = create_parser(str(path), type="libsvm", threaded=False)
+    try:
+        with pytest.raises(ValueError, match="feature"):
+            list(parser)
+    finally:
+        parser.close()
+
+
+def test_proc_backend_label_only_rows(tmp_path, monkeypatch):
+    """A sub-range of featureless rows (rows > 0, zero nonzeros) must flow
+    through the shm transport like any other — the empty index column comes
+    back as a len-0 array, not None (regression: crashed attach_block)."""
+    path = tmp_path / "labels.libsvm"
+    path.write_bytes(b"".join(b"%d\n" % (i % 2) for i in range(2000)))
+    monkeypatch.setenv("DMLC_PARSE_PROC", "2")
+    parser = create_parser(str(path), type="libsvm", threaded=False)
+    blocks = list(parser)
+    parser.close()
+    assert sum(b.size for b in blocks) == 2000
+    assert all(b.num_nonzero == 0 for b in blocks)
+    labels = np.concatenate([b.label for b in blocks])
+    np.testing.assert_array_equal(labels, np.arange(2000) % 2)
+
+
+def test_failed_chunk_leaks_no_shm_segments(tmp_path, monkeypatch):
+    """When one range of a chunk fails, the sibling ranges' segments must
+    be unlinked before the error propagates (the workers hand lifetime to
+    the consumer, so a dropped meta would leak /dev/shm until reboot)."""
+    import gc
+
+    rng = np.random.RandomState(0)
+    good = [f"{i%2} " + " ".join(f"{j}:{rng.rand():.3f}" for j in range(4))
+            for i in range(3000)]
+    good[2900] = "1 broken:token"               # lands in a late range
+    path = tmp_path / "mixed.libsvm"
+    path.write_text("\n".join(good) + "\n")
+    def segments():
+        # SharedMemory names use the psm_ prefix; the executor's own
+        # sem.mp-* semaphores are tracker-cleaned and not ours to count
+        if not os.path.isdir("/dev/shm"):
+            return None
+        return {n for n in os.listdir("/dev/shm") if n.startswith("psm_")}
+
+    before = segments()
+    monkeypatch.setenv("DMLC_PARSE_PROC", "2")
+    parser = create_parser(str(path), type="libsvm", threaded=False)
+    with pytest.raises(ValueError):
+        list(parser)
+    parser.close()
+    gc.collect()
+    if before is not None:
+        assert segments() - before == set()
+
+
+def test_resolve_nproc_parsing():
+    assert parse_proc.resolve_nproc({"DMLC_PARSE_PROC": "4"}) == 4
+    assert parse_proc.resolve_nproc({"DMLC_PARSE_PROC": "0"}) == 0
+    assert parse_proc.resolve_nproc({"DMLC_PARSE_PROC": "off"}) == 0
+    assert parse_proc.resolve_nproc({}) == 0
+    assert parse_proc.resolve_nproc({"DMLC_PARSE_PROC": "junk"}) == 0
+    assert parse_proc.resolve_nproc({"DMLC_PARSE_PROC": "auto"}) >= 1
+
+
+def test_shm_leases_release(tmp_path, monkeypatch):
+    """Dropping the last RowBlock view releases its shm lease (the gauge
+    returns to zero), and /dev/shm does not accumulate segments."""
+    import gc
+
+    from dmlc_core_tpu import telemetry
+
+    uri = _gen_corpus(tmp_path, "libsvm", rows=2000)
+    monkeypatch.setenv("DMLC_PARSE_PROC", "2")
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        parser = create_parser(uri, type="libsvm", threaded=False)
+        blocks = list(parser)
+        assert sum(b.size for b in blocks) == 2000
+        gauge = telemetry.get_registry().gauge("dmlc_parse_shm_bytes_in_flight")
+        assert gauge.value > 0
+        del blocks
+        gc.collect()
+        assert gauge.value == 0
+        parser.close()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# -- chaos: killed worker -----------------------------------------------------
+
+_KILL_PLAN = ('{"rules": [{"site": "data.parse_worker", "kind": "exit", '
+              '"times": null}]}')
+
+
+@pytest.mark.chaos
+def test_killed_parse_worker_surfaces_clean_error(tmp_path, monkeypatch):
+    """A worker kill-at-site (fault kind 'exit') mid-chunk must surface as
+    a RuntimeError on the consumer — with the ThreadedParser decorator in
+    the stack, exactly where parse errors normally arrive — and never hang.
+
+    The plan rides the environment so it reaches the workers under both
+    fork and spawn start methods (workers re-init fault from env)."""
+    uri = _gen_corpus(tmp_path, "libsvm", rows=3000)
+    monkeypatch.setenv("DMLC_PARSE_PROC", "2")
+    monkeypatch.setenv("DMLC_FAULT_PLAN", _KILL_PLAN)
+    parse_proc.shutdown()   # workers read plans at start: force a fresh pool
+    parser = create_parser(uri, type="libsvm", threaded=True)
+    try:
+        with pytest.raises(RuntimeError, match="parse worker died"):
+            list(parser)
+    finally:
+        parser.close()
+
+
+@pytest.mark.chaos
+def test_killed_worker_then_fresh_parser_recovers(tmp_path, monkeypatch):
+    uri = _gen_corpus(tmp_path, "libsvm", rows=500)
+    monkeypatch.setenv("DMLC_PARSE_PROC", "2")
+    monkeypatch.setenv("DMLC_FAULT_PLAN", _KILL_PLAN)
+    parse_proc.shutdown()   # workers read plans at start: force a fresh pool
+    broken = create_parser(uri, type="libsvm", threaded=False)
+    try:
+        with pytest.raises(RuntimeError):
+            list(broken)
+    finally:
+        broken.close()
+    monkeypatch.delenv("DMLC_FAULT_PLAN")
+    clean = create_parser(uri, type="libsvm", threaded=False)
+    assert sum(b.size for b in clean) == 500
+    clean.close()
+
+
+@pytest.mark.chaos
+def test_same_parser_self_heals_after_worker_death(tmp_path, monkeypatch):
+    """The documented self-heal covers a *retried* parser too: after a
+    worker death discards the shared pool, the same parser's next epoch
+    must build a fresh pool instead of submitting to the dead executor."""
+    uri = _gen_corpus(tmp_path, "libsvm", rows=500)
+    monkeypatch.setenv("DMLC_PARSE_PROC", "2")
+    monkeypatch.setenv("DMLC_FAULT_PLAN", _KILL_PLAN)
+    parse_proc.shutdown()
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    try:
+        with pytest.raises(RuntimeError, match="parse worker died"):
+            list(parser)
+        monkeypatch.delenv("DMLC_FAULT_PLAN")  # new workers read env afresh
+        parser.before_first()
+        assert sum(b.size for b in parser) == 500
+    finally:
+        parser.close()

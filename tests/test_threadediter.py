@@ -467,3 +467,125 @@ def test_raising_stall_hook_does_not_kill_producer():
         telemetry.reset()
         if was_enabled:
             telemetry.enable()
+
+
+# -- byte-bounded capacity (DMLC_PARSE_QUEUE_BYTES plumbing) ------------------
+
+class SizedProducer:
+    """Items that cost 100 "bytes" each under the cost hook."""
+
+    def __init__(self, n):
+        self.n = n
+        self.i = 0
+
+    def before_first(self):
+        self.i = 0
+
+    def next(self, reuse):
+        if self.i >= self.n:
+            return None
+        self.i += 1
+        return ("item", self.i - 1)
+
+
+def test_byte_bound_blocks_producer():
+    """With max_bytes=250 and 100-cost items, at most 3 items ever queue
+    (the bound is checked before producing, so one overshoot item fits)."""
+    it = ThreadedIter(max_capacity=64, name="bytes",
+                      max_bytes=250, cost_fn=lambda item: 100)
+    seen_qbytes = []
+    it.init(SizedProducer(20))
+    out = []
+    while True:
+        time.sleep(0.01)                    # let the producer fill the queue
+        seen_qbytes.append(it.qbytes())
+        item = it.next()
+        if item is None:
+            break
+        out.append(item[1])
+    assert out == list(range(20))
+    assert max(seen_qbytes) <= 300          # 250 bound + one overshoot item
+    assert it.qbytes() == 0
+    assert it.producer_stalls >= 1          # the byte bound did block
+    it.destroy()
+
+
+def test_byte_bound_admits_oversized_single_item():
+    """One item costing more than max_bytes must flow, not deadlock."""
+    it = ThreadedIter(SizedProducer(3), max_capacity=8, name="big",
+                      max_bytes=10, cost_fn=lambda item: 1000)
+    out = [it.next() for _ in range(3)]
+    assert [o[1] for o in out] == [0, 1, 2]
+    assert it.next() is None
+    it.destroy()
+
+
+def test_byte_bound_reset_clears_queue_bytes():
+    it = ThreadedIter(SizedProducer(50), max_capacity=64, name="resetb",
+                      max_bytes=10_000, cost_fn=lambda item: 100)
+    assert it.next()[1] == 0
+    time.sleep(0.02)
+    assert it.qbytes() > 0
+    it.before_first()
+    assert it.qbytes() == 0
+    out = []
+    while True:
+        item = it.next()
+        if item is None:
+            break
+        out.append(item[1])
+    assert out == list(range(50))
+    it.destroy()
+
+
+def test_broken_cost_hook_costs_zero_and_survives():
+    def bad_cost(item):
+        raise RuntimeError("cost bug")
+
+    it = ThreadedIter(SizedProducer(10), max_capacity=4, name="badcost",
+                      max_bytes=100, cost_fn=bad_cost)
+    out = []
+    while True:
+        item = it.next()
+        if item is None:
+            break
+        out.append(item[1])
+    assert out == list(range(10))
+    assert it.qbytes() == 0
+    it.destroy()
+
+
+def test_parse_queue_bytes_env(monkeypatch):
+    from dmlc_core_tpu.data import parser as parser_mod
+
+    monkeypatch.delenv("DMLC_PARSE_QUEUE_BYTES", raising=False)
+    assert parser_mod._parse_queue_bytes() == parser_mod.DEFAULT_PARSE_QUEUE_BYTES
+    monkeypatch.setenv("DMLC_PARSE_QUEUE_BYTES", "1048576")
+    assert parser_mod._parse_queue_bytes() == 1 << 20
+    monkeypatch.setenv("DMLC_PARSE_QUEUE_BYTES", "0")
+    assert parser_mod._parse_queue_bytes() is None
+    monkeypatch.setenv("DMLC_PARSE_QUEUE_BYTES", "garbage")
+    assert parser_mod._parse_queue_bytes() == parser_mod.DEFAULT_PARSE_QUEUE_BYTES
+
+
+def test_queue_bytes_gauge_exported():
+    from dmlc_core_tpu import telemetry
+
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        it = ThreadedIter(SizedProducer(5), max_capacity=8, name="gaugeb",
+                          max_bytes=10_000, cost_fn=lambda item: 100)
+        while it.next() is not None:
+            pass
+        gauge = telemetry.get_registry().gauge(
+            "dmlc_threadediter_queue_bytes", name="gaugeb")
+        assert gauge.value == 0             # drained; series exists
+        it.destroy()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+        if was_enabled:
+            telemetry.enable()
