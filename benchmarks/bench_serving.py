@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Serving load harness: the SLO proof and the knee-curve capture.
+
+    python benchmarks/bench_serving.py smoke [--out slo.json]
+        [--fault-plan benchmarks/serving_fault_plan.json | none]
+    python benchmarks/bench_serving.py knee [--out knee.json]
+        [--qps 50,100,200] [--knobs 1:0.5,8:2,32:5] [--duration 3]
+
+``smoke`` is the CI gate (docs/serving.md "SLO methodology"): it starts an
+in-process scoring server, drives open-loop traffic through an **active
+fault plan** (injected request stalls, a 503 storm, a queue stall, one
+killed predict call), and exits non-zero unless every request either
+completed or was shed with a structured 503 — ``crashed == 0`` — and the
+faults demonstrably fired.  The JSON report it writes is the artifact.
+
+``knee`` sweeps offered load across 2-3 ``max_batch:max_delay_ms`` knob
+settings and records client-side latency quantiles per point — the
+latency/throughput knee curve committed under benchmarks/results/.
+"""
+
+import argparse
+import json
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "serving_fault_plan.json")
+NUM_FEATURE = 16
+
+
+def _host_info():
+    return {"cores": os.cpu_count(), "python": platform.python_version(),
+            "platform": platform.platform()}
+
+
+def _start_server(max_batch, max_delay_ms, max_queue_bytes=None):
+    from dmlc_core_tpu import telemetry
+    from dmlc_core_tpu.serve import ScoringServer, build_runtime
+
+    telemetry.enable()
+    runtime = build_runtime("linear", NUM_FEATURE)
+    return ScoringServer(runtime, max_batch=max_batch,
+                         max_delay_ms=max_delay_ms,
+                         max_queue_bytes=max_queue_bytes).start()
+
+
+def run_smoke(args) -> int:
+    from dmlc_core_tpu import fault
+    from dmlc_core_tpu.serve.loadgen import run_load
+
+    plan_path = args.fault_plan
+    plan_active = plan_path.lower() != "none"
+    if plan_active:
+        with open(plan_path, encoding="utf-8") as f:
+            fault.configure(f.read())
+    server = _start_server(max_batch=32, max_delay_ms=2.0)
+    try:
+        report = run_load(server.url, qps=args.qps, duration_s=args.duration,
+                          num_feature=NUM_FEATURE, rows_per_request=2,
+                          seed=7, timeout_s=8.0)
+    finally:
+        server.close()
+    report["fault_plan"] = plan_path if plan_active else None
+    report["host"] = _host_info()
+    fired = [(site, kind) for site, kind, _ in fault.fires()]
+    report["faults_fired"] = sorted(set(fired))
+
+    counts = report["counts"]
+    failures = []
+    if counts["ok"] == 0:
+        failures.append("no request succeeded")
+    if counts["crashed"] or counts["error"]:
+        failures.append(
+            f"{counts['crashed']} crashed + {counts['error']} unstructured "
+            "errors — the degradation contract is broken")
+    if plan_active:
+        if counts["shed"] == 0:
+            failures.append("fault plan active but nothing was shed "
+                            "(plan not reaching the server?)")
+        if ("serve.predict", "error") not in fired:
+            failures.append("the killed-predict fault never fired")
+        if not any(site == "serve.queue" for site, _ in fired):
+            failures.append("the queue-stall fault never fired")
+    report["slo_ok"] = not failures
+    report["slo_failures"] = failures
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    print(json.dumps(report, indent=1, sort_keys=True))
+    lat = report["latency_ms"]
+    print(f"\nSLO smoke: {counts['ok']} ok / {counts['shed']} shed / "
+          f"{counts['timeout']} timeout / {counts['crashed']} crashed "
+          f"of {report['requests']} @ {args.qps} qps offered; "
+          f"p50={lat['p50']}ms p99={lat['p99']}ms "
+          f"shed_rate={report['shed_rate']}")
+    for msg in failures:
+        print(f"SLO FAILURE: {msg}")
+    if plan_active:
+        print(f"faults fired: {report['faults_fired']}")
+    return 0 if not failures else 1
+
+
+def run_knee(args) -> int:
+    from dmlc_core_tpu import telemetry
+    from dmlc_core_tpu.serve.loadgen import run_load
+
+    qps_list = [float(q) for q in args.qps_list.split(",")]
+    knobs = []
+    for spec in args.knobs.split(","):
+        batch, delay = spec.split(":")
+        knobs.append((int(batch), float(delay)))
+    runs = []
+    for max_batch, delay_ms in knobs:
+        for qps in qps_list:
+            telemetry.reset()  # fresh server-side histograms per point
+            server = _start_server(max_batch=max_batch,
+                                   max_delay_ms=delay_ms)
+            try:
+                rep = run_load(server.url, qps=qps,
+                               duration_s=args.duration,
+                               num_feature=NUM_FEATURE,
+                               rows_per_request=args.rows, seed=11)
+            finally:
+                server.close()
+            lat = rep["latency_ms"]
+            runs.append({"max_batch": max_batch, "max_delay_ms": delay_ms,
+                         "offered_qps": qps,
+                         "achieved_qps": rep["achieved_qps"],
+                         "shed_rate": rep["shed_rate"],
+                         "counts": rep["counts"],
+                         "latency_ms": lat,
+                         "server": rep.get("server")})
+            print(f"batch={max_batch:<3} delay={delay_ms:<4}ms "
+                  f"offered={qps:<6g} achieved={rep['achieved_qps']:<7g} "
+                  f"p50={lat['p50']}ms p99={lat['p99']}ms "
+                  f"shed={rep['shed_rate']}")
+    out = {"host": _host_info(), "num_feature": NUM_FEATURE,
+           "rows_per_request": args.rows, "duration_s": args.duration,
+           "runs": runs}
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        print(f"wrote {args.out}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sm = sub.add_parser("smoke", help="CI SLO gate under an active fault plan")
+    sm.add_argument("--out", default=None)
+    sm.add_argument("--fault-plan", default=DEFAULT_PLAN,
+                    help="plan JSON path, or 'none' to disable injection")
+    sm.add_argument("--qps", type=float, default=120.0)
+    sm.add_argument("--duration", type=float, default=4.0)
+    kn = sub.add_parser("knee", help="latency-vs-load sweep across knobs")
+    kn.add_argument("--out", default=None)
+    kn.add_argument("--qps", dest="qps_list", default="50,100,200,400")
+    kn.add_argument("--knobs", default="1:0.5,8:2,32:5",
+                    help="comma list of max_batch:max_delay_ms settings")
+    kn.add_argument("--duration", type=float, default=3.0)
+    kn.add_argument("--rows", type=int, default=1)
+    args = p.parse_args(argv)
+    return run_smoke(args) if args.cmd == "smoke" else run_knee(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,7 @@ CLI_EXEMPT = {
     "dmlc_core_tpu/telemetry/report.py",  # `telemetry report` CLI table
     "dmlc_core_tpu/telemetry/__main__.py",
     "dmlc_core_tpu/fault/__main__.py",  # `fault validate` CLI report
+    "dmlc_core_tpu/serve/__main__.py",  # `python -m dmlc_core_tpu.serve` CLI
 }
 
 # the deep passes run on library code only; tests/examples get syntax checks

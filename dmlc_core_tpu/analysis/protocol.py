@@ -27,8 +27,10 @@ from dmlc_core_tpu.analysis.driver import FileContext, Finding
 
 __all__ = ["run", "PROTOCOL_PREFIXES", "WIRE_INGEST_CALLS"]
 
-# the network-facing layers this discipline applies to
-PROTOCOL_PREFIXES = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/io/")
+# the network-facing layers this discipline applies to (serve/ handles
+# arbitrary HTTP clients: same hostile-peer posture as the tracker wire)
+PROTOCOL_PREFIXES = ("dmlc_core_tpu/tracker/", "dmlc_core_tpu/io/",
+                     "dmlc_core_tpu/serve/")
 
 # method names whose presence marks a function as ingesting external bytes
 WIRE_INGEST_CALLS = {

@@ -88,10 +88,17 @@ _register_batch_pytree(SparseBatch,
 
 def bucket_size(n: int, minimum: int = 256) -> int:
     """Round up to the bucket ladder: 1.5x-spaced powers-of-two-ish sizes so
-    recompiles are O(log nnz) (static-shape discipline)."""
+    recompiles are O(log nnz) (static-shape discipline).
+
+    From ``minimum=1`` the ladder runs 1, 2, 3, 4, 6, 8, 12, 16, ... — the
+    serving scheduler uses it to bucket *batch* dimensions (serve/scheduler
+    pads coalesced request batches to the next rung so jitted predict fns
+    compile O(log max_batch) shapes, not one per arrival pattern).
+    """
     b = minimum
     while b < n:
-        b = b * 3 // 2 if (b & (b - 1)) == 0 else 1 << (b.bit_length())
+        # b=1 must step to 2 (1*3//2 would stick at 1 forever)
+        b = b * 3 // 2 if (b & (b - 1)) == 0 and b > 1 else 1 << (b.bit_length())
     return b
 
 
@@ -157,7 +164,18 @@ def block_to_sparse(block: RowBlock, nnz_bucket: Optional[int] = None,
 
 
 class _Rebatcher:
-    """Slice a stream of variable-size RowBlocks into fixed-size batches."""
+    """Slice a stream of variable-size RowBlocks into fixed-size batches.
+
+    Final-partial-batch contract: with ``drop_remainder=False`` (the
+    default) the leftover ``0 < r < batch_size`` rows are emitted as one
+    short block — the downstream ``block_to_dense`` / ``block_to_sparse``
+    pad it back up to ``batch_size`` with **masked** rows (``weight == 0``,
+    ``label == 0``, ``num_rows == r``), so consumers see only static
+    shapes and slice/weight the padding away; an empty parser yields no
+    batches at all (never an all-padding one).  With
+    ``drop_remainder=True`` the short tail is dropped (equal step counts
+    across data-parallel workers matter more than the last rows).
+    """
 
     def __init__(self, parser: Parser, batch_size: int, drop_remainder: bool):
         self._parser = parser
@@ -185,7 +203,13 @@ class _Rebatcher:
 def dense_batches(parser: Parser, batch_size: int, num_feature: int,
                   drop_remainder: bool = False,
                   fill_value: float = 0.0) -> Iterator[DenseBatch]:
-    """Fixed-size dense batches from a parser (remainder zero-padded).
+    """Fixed-size dense batches from a parser.
+
+    Every yielded batch is exactly ``[batch_size, num_feature]``; the
+    final partial batch (``drop_remainder=False``) arrives zero-padded
+    with the mask in ``weight`` (0.0 on padding rows — explicit row
+    weights are preserved on real rows) and the true row count in
+    ``num_rows`` (see :class:`_Rebatcher` for the full contract).
 
     ``fill_value=np.nan`` marks absent features as missing for
     sparsity-aware GBDT training (see :func:`block_to_dense`).
@@ -198,7 +222,13 @@ def dense_batches(parser: Parser, batch_size: int, num_feature: int,
 def sparse_batches(parser: Parser, batch_size: int,
                    nnz_bucket: Optional[int] = None,
                    drop_remainder: bool = False) -> Iterator[SparseBatch]:
-    """Fixed-size flat-COO batches; nnz padded to a bucket ladder."""
+    """Fixed-size flat-COO batches; nnz padded to a bucket ladder.
+
+    The final partial batch (``drop_remainder=False``) keeps the static
+    ``[batch_size]`` row axis: padding rows carry ``weight == 0`` and
+    padding nnz slots carry ``row_id == batch_size`` (the segment-sum
+    drop segment), with the true row count in ``num_rows``.
+    """
     for block in _Rebatcher(parser, batch_size, drop_remainder):
         cap = nnz_bucket or bucket_size(block.num_nonzero or 1)
         yield block_to_sparse(block, cap, batch_size)

@@ -19,7 +19,9 @@ Injection sites (see :data:`SITES`):
 - ``io.stream.read``       — :meth:`Stream.read_exact` (``truncate`` rules);
 - ``threadediter.produce`` — the producer thread, per item;
 - ``data.parse_worker``    — process-pool parse workers, per sub-range
-  (``exit`` = kill a worker mid-chunk).
+  (``exit`` = kill a worker mid-chunk);
+- ``serve.request`` / ``serve.queue`` / ``serve.predict`` — the scoring
+  service's ingress, batch assembly, and model call (docs/serving.md).
 
 **Disabled is the default and costs one attribute load + branch**: every
 helper returns immediately while no plan is configured, and the instrumented
@@ -85,6 +87,21 @@ SITES: Dict[str, str] = {
         "(ctx: parser=<class>); 'exit' kills the worker mid-chunk.  "
         "Workers read DMLC_FAULT_PLAN at start: the shared pool must be "
         "(re)started after setting the plan (data.parse_proc.shutdown())"),
+    "serve.request": (
+        "scoring HTTP ingress, once per POST /v1/score before parsing; "
+        "'http_status' REPLACES the response (the chaos 503 storm), "
+        "delay/stall model a slow handler thread, 'reset' kills the "
+        "connection mid-request (the one outcome a client sees as a "
+        "crash)"),
+    "serve.queue": (
+        "micro-batch assembly loop, once per batch (ctx: depth=<queue "
+        "depth>); 'stall' models a stuck consumer — the queue backs up "
+        "and admission control starts shedding (503 + Retry-After)"),
+    "serve.predict": (
+        "once per assembled batch before the model call (ctx: "
+        "model=<family>, rows=<n>); 'error' models a killed predict "
+        "worker — that batch's requests fail with a structured 503 "
+        "predict_failed and the batcher continues"),
 }
 
 _plan: Optional[FaultPlan] = None

@@ -1,0 +1,36 @@
+"""Online scoring: the "millions of users" workload over the training stack.
+
+The reference dmlc-core stops at training-side plumbing; this package is
+the serving path the ROADMAP's north star demands, built entirely out of
+subsystems earlier PRs shipped:
+
+- **micro-batching** (:mod:`.scheduler`) — concurrent requests coalesce
+  into one padded batch per predict call, batch shapes drawn from the
+  ``bridge.batching`` bucket ladder so jitted predict functions compile
+  O(log max_batch) shapes (warmed at load, :mod:`.model_runtime`);
+- **admission control** (:mod:`.admission`) — PR 4's byte-bounded
+  backpressure at the front door: queue-bytes reservations, structured
+  503 + Retry-After sheds, never OOM;
+- **transport** (:mod:`.server`, ``python -m dmlc_core_tpu.serve``) —
+  stdlib threading HTTP with every stage in the PR 2 telemetry registry
+  (request/queue/batch/predict spans, latency histograms with live
+  p50/p95/p99 on ``/stats``) and PR 3 fault sites ``serve.request`` /
+  ``serve.queue`` / ``serve.predict`` wired through the hot path;
+- **proof** (:mod:`.loadgen`, ``benchmarks/bench_serving.py``) — an
+  open-loop load harness that drives fault plans through the service and
+  emits a JSON SLO report; the CI ``serve`` job fails unless every
+  request under an active fault plan completes or sheds structurally.
+
+See docs/serving.md for the architecture, the knee-curve methodology, and
+every knob.
+"""
+
+from dmlc_core_tpu.serve.admission import AdmissionController  # noqa: F401
+from dmlc_core_tpu.serve.errors import (BadRequest, Overloaded,  # noqa: F401
+                                        PredictFailed, RequestTimeout,
+                                        ServeError)
+from dmlc_core_tpu.serve.model_runtime import (GBDTRuntime,  # noqa: F401
+                                               LinearRuntime, MLPRuntime,
+                                               ModelRuntime, build_runtime)
+from dmlc_core_tpu.serve.scheduler import MicroBatcher, batch_buckets  # noqa: F401
+from dmlc_core_tpu.serve.server import ScoringServer  # noqa: F401

@@ -1,0 +1,92 @@
+"""``python -m dmlc_core_tpu.serve`` — run the scoring service.
+
+Examples::
+
+    # a synthetic linear scorer on :8080 with the default knee knobs
+    python -m dmlc_core_tpu.serve --model linear --num-feature 28 --port 8080
+
+    # tighter latency knee, explicit byte bound, telemetry flushing
+    DMLC_TELEMETRY_DIR=/tmp/t python -m dmlc_core_tpu.serve \
+        --model mlp --num-feature 28 --max-batch 32 --max-delay-ms 1 \
+        --max-queue-bytes 33554432
+
+The process serves until SIGINT/SIGTERM; ``/healthz``, ``/metrics`` and
+``/stats`` are live immediately after the warmup line prints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.serve.model_runtime import build_runtime
+from dmlc_core_tpu.serve.server import ScoringServer
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dmlc_core_tpu.serve",
+        description="low-latency scoring service (micro-batching + "
+                    "admission control; docs/serving.md)")
+    p.add_argument("--model", default="linear",
+                   choices=["linear", "mlp", "gbdt"],
+                   help="model family (seeded synthetic params unless "
+                        "--checkpoint)")
+    p.add_argument("--num-feature", type=int, default=28)
+    p.add_argument("--checkpoint", default=None,
+                   help="bridge/checkpoint.py URI with trained params "
+                        "(linear/mlp)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="rows per predict call (throughput knob)")
+    p.add_argument("--max-delay-ms", type=float, default=2.0,
+                   help="batch assembly wait (latency knob)")
+    p.add_argument("--max-queue-bytes", type=int, default=None,
+                   help="admission bound (default: DMLC_SERVE_QUEUE_BYTES "
+                        "or 64 MiB)")
+    p.add_argument("--request-timeout-s", type=float, default=10.0)
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip compile-ahead warmup (first requests of each "
+                        "batch shape will pay XLA compilation)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    # honor an explicit JAX_PLATFORMS request even under plugin-pinning
+    # images (the same discipline the examples follow)
+    from dmlc_core_tpu.utils.platform import sync_platform_from_env
+
+    sync_platform_from_env()
+    # a server without metrics cannot state its SLOs: collection on
+    # unconditionally (flushing still needs DMLC_TELEMETRY_DIR)
+    telemetry.enable()
+    runtime = build_runtime(args.model, args.num_feature, seed=args.seed,
+                            checkpoint=args.checkpoint)
+    server = ScoringServer(
+        runtime, host=args.host, port=args.port, max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms, max_queue_bytes=args.max_queue_bytes,
+        request_timeout_s=args.request_timeout_s, warmup=not args.no_warmup)
+    stop = threading.Event()
+
+    def _signal(signum, frame):  # noqa: ARG001 (signal contract)
+        stop.set()
+
+    signal.signal(signal.SIGINT, _signal)
+    signal.signal(signal.SIGTERM, _signal)
+    with server:
+        print(f"serving {runtime.name} on {server.url} "
+              f"(ctrl-c to stop)")
+        stop.wait()
+    print("serve: shut down cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

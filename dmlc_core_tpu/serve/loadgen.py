@@ -1,0 +1,207 @@
+"""Open-loop load generator + the SLO accounting it reports.
+
+**Open-loop matters**: a closed-loop client (send, wait, send) slows down
+exactly when the server does, hiding the latency it came to measure
+(coordinated omission).  Here arrivals are a seeded Poisson process at the
+offered rate, dispatched on schedule regardless of how many requests are
+still in flight, and each request's latency is measured **from its
+scheduled arrival time** — a dispatch that couldn't start on time counts
+against the server, not for it.
+
+Outcome taxonomy (the SLO vocabulary of docs/serving.md):
+
+=============  ==============================================================
+``ok``         200 with a parseable predictions body of the right length
+``shed``       structured 503 (admission, predict-failure, injected storm)
+``timeout``    structured 504, or the client-side deadline elapsed
+``rejected``   structured 4xx (the load was malformed — a client bug)
+``error``      any other structured 5xx
+``crashed``    no structured answer at all: connection refused/reset,
+               truncated body, unparseable response
+=============  ==============================================================
+
+The graceful-degradation proof is ``crashed == 0`` under an active fault
+plan: every request got *an* answer, even if that answer was "not now".
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from dmlc_core_tpu.telemetry import clock
+
+__all__ = ["run_load", "percentile", "LoadReport"]
+
+OUTCOMES = ("ok", "shed", "timeout", "rejected", "error", "crashed")
+
+LoadReport = Dict[str, Any]
+
+
+def percentile(sorted_values: List[float], q: float) -> Optional[float]:
+    """Exact (linear-interpolated) percentile of a pre-sorted sample."""
+    if not sorted_values:
+        return None
+    if len(sorted_values) == 1:
+        return sorted_values[0]
+    pos = q * (len(sorted_values) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_values) - 1)
+    frac = pos - lo
+    return sorted_values[lo] * (1 - frac) + sorted_values[hi] * frac
+
+
+def _gen_rows(rng: random.Random, n: int, num_feature: int) -> List[List[float]]:
+    return [[rng.uniform(-1.0, 1.0) for _ in range(num_feature)]
+            for _ in range(n)]
+
+
+class _Recorder:
+    """Thread-safe outcome/latency sink."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.counts = {k: 0 for k in OUTCOMES}
+        self.latencies_ok: List[float] = []
+        self.latencies_all: List[float] = []
+        self.statuses: Dict[str, int] = {}
+
+    def record(self, outcome: str, latency_s: float,
+               status: Optional[int]) -> None:
+        with self.lock:
+            self.counts[outcome] += 1
+            self.latencies_all.append(latency_s)
+            if outcome == "ok":
+                self.latencies_ok.append(latency_s)
+            if status is not None:
+                key = str(status)
+                self.statuses[key] = self.statuses.get(key, 0) + 1
+
+
+def _issue(url: str, body: bytes, timeout_s: float,
+           expect_rows: int) -> tuple:
+    """One POST; returns (outcome, status|None)."""
+    req = urllib.request.Request(
+        url + "/v1/score", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            payload = json.load(resp)
+            preds = payload.get("predictions")
+            if isinstance(preds, list) and len(preds) == expect_rows:
+                return "ok", resp.status
+            return "crashed", resp.status  # 200 with a wrong-shaped body
+    except urllib.error.HTTPError as e:
+        status = e.code
+        try:
+            err = json.load(e)
+            structured = isinstance(err, dict) and "error" in err
+        except Exception:
+            structured = False
+        if not structured:
+            return "crashed", status
+        if status == 503:
+            return "shed", status
+        if status == 504:
+            return "timeout", status
+        if 400 <= status < 500:
+            return "rejected", status
+        return "error", status
+    except TimeoutError:
+        return "timeout", None
+    except urllib.error.URLError as e:
+        # urllib wraps connect-phase deadline expiry in URLError: that is
+        # the client's deadline, not a server crash
+        if isinstance(getattr(e, "reason", None), TimeoutError):
+            return "timeout", None
+        return "crashed", None
+    except (ConnectionError, OSError):
+        return "crashed", None
+    except Exception:
+        return "crashed", None
+
+
+def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
+             rows_per_request: int = 1, seed: int = 0,
+             timeout_s: float = 10.0, max_workers: int = 64) -> LoadReport:
+    """Drive open-loop traffic at ``qps`` for ``duration_s``; returns the
+    SLO report dict (see module docstring for the outcome taxonomy)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    rng = random.Random(seed)
+    # Poisson arrival offsets within [0, duration)
+    arrivals: List[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(qps)
+        if t >= duration_s:
+            break
+        arrivals.append(t)
+    bodies = [json.dumps({"instances": _gen_rows(rng, rows_per_request,
+                                                 num_feature)}).encode()
+              for _ in arrivals]
+    rec = _Recorder()
+    start = clock.monotonic()
+
+    def fire(scheduled_at: float, body: bytes) -> None:
+        outcome, status = _issue(url, body, timeout_s, rows_per_request)
+        rec.record(outcome, clock.monotonic() - start - scheduled_at, status)
+
+    with ThreadPoolExecutor(max_workers=max_workers,
+                            thread_name_prefix="loadgen") as pool:
+        for at, body in zip(arrivals, bodies):
+            delay = at - (clock.monotonic() - start)
+            if delay > 0:
+                time.sleep(delay)
+            pool.submit(fire, at, body)
+        # pool __exit__ joins all in-flight requests
+    wall = clock.monotonic() - start
+
+    lat_ok = sorted(rec.latencies_ok)
+    lat_all = sorted(rec.latencies_all)
+    n = len(arrivals)
+    report: LoadReport = {
+        "offered_qps": qps,
+        "duration_s": duration_s,
+        "wall_s": round(wall, 3),
+        "rows_per_request": rows_per_request,
+        "requests": n,
+        "counts": dict(rec.counts),
+        "statuses": dict(sorted(rec.statuses.items())),
+        "achieved_qps": round(rec.counts["ok"] / wall, 2) if wall else 0.0,
+        "shed_rate": round(rec.counts["shed"] / n, 4) if n else 0.0,
+        "error_rate": round((rec.counts["error"] + rec.counts["crashed"])
+                            / n, 4) if n else 0.0,
+        "latency_ms": {
+            "p50": _ms(percentile(lat_ok, 0.50)),
+            "p95": _ms(percentile(lat_ok, 0.95)),
+            "p99": _ms(percentile(lat_ok, 0.99)),
+            "max": _ms(lat_ok[-1] if lat_ok else None),
+        },
+        "latency_all_ms": {
+            "p50": _ms(percentile(lat_all, 0.50)),
+            "p99": _ms(percentile(lat_all, 0.99)),
+        },
+    }
+    server_stats = _fetch_stats(url, timeout_s)
+    if server_stats is not None:
+        report["server"] = server_stats
+    return report
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    return None if seconds is None else round(seconds * 1e3, 3)
+
+
+def _fetch_stats(url: str, timeout_s: float) -> Optional[Dict[str, Any]]:
+    try:
+        with urllib.request.urlopen(url + "/stats",
+                                    timeout=timeout_s) as resp:
+            return json.load(resp)
+    except Exception:
+        return None

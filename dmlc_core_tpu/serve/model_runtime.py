@@ -1,0 +1,206 @@
+"""Model runtimes: the three model families behind one predict surface.
+
+A :class:`ModelRuntime` owns everything the scoring path needs from a
+model: the compiled predict function, the feature-dimension contract, and
+**warmup** — compiling every batch-bucket shape at load time so the first
+request of each shape pays queueing, not XLA compilation.  The scheduler
+(:mod:`.scheduler`) only ever sees ``predict(x[B, F]) -> y[B] | y[B, K]``
+with ``B`` drawn from the bucket ladder it warmed up.
+
+Runtimes wrap the existing model families unchanged:
+
+- :class:`LinearRuntime` — :class:`~dmlc_core_tpu.models.linear.LinearModel`
+  params (margin / sigmoid);
+- :class:`MLPRuntime` — :class:`~dmlc_core_tpu.models.mlp.MLP` params
+  (softmax probabilities, or the regression head);
+- :class:`GBDTRuntime` — a trained
+  :class:`~dmlc_core_tpu.models.gbdt.TreeEnsemble` plus the binning
+  boundaries (``bin_features`` then the ensemble's jitted predict).
+
+:func:`build_runtime` constructs seeded synthetic instances for the CLI,
+the load bench, and tests — real deployments construct runtimes from
+checkpointed params (``bridge/checkpoint.py``) the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.utils.logging import CHECK, log_info
+
+__all__ = ["ModelRuntime", "LinearRuntime", "MLPRuntime", "GBDTRuntime",
+           "build_runtime"]
+
+
+class ModelRuntime:
+    """Base: a named predict function with a fixed feature contract."""
+
+    #: model-family tag carried into metrics labels and /healthz
+    name: str = "base"
+
+    def __init__(self, num_feature: int):
+        CHECK(num_feature >= 1, "num_feature must be >= 1")
+        self.num_feature = int(num_feature)
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """``[B, F] float32 -> [B]`` scores or ``[B, K]`` probabilities.
+
+        ``B`` is a padded bucket size; padding rows produce garbage scores
+        the scheduler slices off — runtimes must tolerate all-zero rows.
+        Returns a **host** ndarray (the device sync happens here, inside
+        the scheduler's predict span).
+        """
+        raise NotImplementedError
+
+    def warmup(self, batch_sizes: Sequence[int]) -> int:
+        """Compile predict for each batch bucket; returns shapes warmed.
+
+        Serving latency SLOs are unmeetable if request N of a new shape
+        pays an XLA compile (hundreds of ms) — so every shape the
+        scheduler can emit is compiled before the listener opens.
+        """
+        warmed = 0
+        for b in sorted(set(int(b) for b in batch_sizes)):
+            with telemetry.span("serve.warmup", model=self.name, batch=b):
+                self.predict(np.zeros((b, self.num_feature), np.float32))
+            telemetry.count("dmlc_serve_warmup_total", model=self.name)
+            warmed += 1
+        log_info(f"serve: warmed {warmed} batch shape(s) for {self.name} "
+                 f"({sorted(set(int(b) for b in batch_sizes))})")
+        return warmed
+
+
+class LinearRuntime(ModelRuntime):
+    """Serving facade over LinearModel params (w, b)."""
+
+    name = "linear"
+
+    def __init__(self, param, params: Dict[str, Any]):
+        super().__init__(param.num_feature)
+        self.param = param
+        self.params = params
+        self._jit = None
+
+    def _fn(self):
+        # memoized on the instance, NOT lru_cache(self): a class-level
+        # cache keyed by self would pin every runtime (params + compiled
+        # executables) for the process lifetime — the knee bench builds
+        # one runtime per sweep point
+        if self._jit is None:
+            import jax
+            import jax.numpy as jnp
+
+            logistic = self.param.loss == "logistic"
+
+            def predict(params, x):
+                margin = x @ params["w"] + params["b"]
+                return (1.0 / (1.0 + jnp.exp(-margin)) if logistic
+                        else margin)
+
+            self._jit = jax.jit(predict)
+        return self._jit
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._fn()(self.params, x))
+
+
+class MLPRuntime(ModelRuntime):
+    """Serving facade over MLP params (softmax probs / regression head)."""
+
+    name = "mlp"
+
+    def __init__(self, model, params: Dict[str, Any]):
+        super().__init__(model.param.num_feature)
+        self.model = model
+        self.params = params
+        self._jit = None
+
+    def _fn(self):
+        if self._jit is None:  # instance-memoized (see LinearRuntime._fn)
+            import jax
+
+            regression = self.model.param.num_class == 1
+
+            def predict(params, x):
+                logits = self.model._apply(params, x)
+                return (logits[:, 0] if regression
+                        else jax.nn.softmax(logits, -1))
+
+            self._jit = jax.jit(predict)
+        return self._jit
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self._fn()(self.params, x))
+
+
+class GBDTRuntime(ModelRuntime):
+    """Serving facade over a trained TreeEnsemble + binning boundaries."""
+
+    name = "gbdt"
+
+    def __init__(self, gbdt, ensemble):
+        CHECK(gbdt.boundaries is not None,
+              "GBDTRuntime needs fitted binning boundaries (make_bins)")
+        super().__init__(gbdt.num_feature)
+        self.gbdt = gbdt
+        self.ensemble = ensemble
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        bins = self.gbdt.bin_features(x)
+        return np.asarray(self.gbdt.predict(self.ensemble, bins))
+
+
+def build_runtime(kind: str, num_feature: int, *, seed: int = 0,
+                  num_class: int = 2, hidden: str = "32,32",
+                  checkpoint: Optional[str] = None) -> ModelRuntime:
+    """Construct a runtime for serving: seeded-synthetic params by default,
+    checkpointed params (``bridge/checkpoint.py`` URI) when given.
+
+    ``gbdt`` fits a small seeded ensemble on synthetic data at build time
+    (there is no meaningful "random ensemble"); linear/mlp use
+    ``init_params(seed)`` — mechanically identical to a trained model for
+    load/latency purposes.
+    """
+    if kind == "linear":
+        from dmlc_core_tpu.models.linear import LinearModel, LinearParam
+
+        param = LinearParam(num_feature=num_feature)
+        model = LinearModel(param)
+        params = model.init_params(seed)
+        if checkpoint:
+            from dmlc_core_tpu.bridge.checkpoint import load_checkpoint
+
+            params = load_checkpoint(checkpoint, template=params)
+        return LinearRuntime(param, params)
+    if kind == "mlp":
+        from dmlc_core_tpu.models.mlp import MLP, MLPParam
+
+        param = MLPParam(num_feature=num_feature, hidden=hidden,
+                         num_class=num_class)
+        model = MLP(param)
+        params = model.init_params(seed)
+        if checkpoint:
+            from dmlc_core_tpu.bridge.checkpoint import load_checkpoint
+
+            params = load_checkpoint(checkpoint, template=params)
+        return MLPRuntime(model, params)
+    if kind == "gbdt":
+        from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+        CHECK(checkpoint is None,
+              "gbdt checkpoint loading is not wired yet; build the runtime "
+              "from a fitted GBDT + ensemble directly")
+        rng = np.random.RandomState(seed)
+        x = rng.normal(size=(256, num_feature)).astype(np.float32)
+        label = (x[:, 0] + 0.5 * x[:, min(1, num_feature - 1)]
+                 > 0).astype(np.float32)
+        gbdt = GBDT(GBDTParam(objective="logistic", num_boost_round=8,
+                              max_depth=3, num_bins=16), num_feature)
+        gbdt.make_bins(x)
+        ensemble, _ = gbdt.fit_binned(gbdt.bin_features(x), label)
+        return GBDTRuntime(gbdt, ensemble)
+    raise ValueError(f"unknown model kind {kind!r} "
+                     "(one of: linear, mlp, gbdt)")

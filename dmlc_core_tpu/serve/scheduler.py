@@ -1,0 +1,337 @@
+"""Micro-batching scheduler: coalesce concurrent requests, pad to buckets.
+
+The latency/throughput knee of an online scorer is set by two knobs:
+
+- ``max_batch`` — the most rows one predict call may carry (throughput
+  ceiling: bigger batches amortize dispatch + matmul overhead);
+- ``max_delay_ms`` — how long the first request of a batch may wait for
+  company (latency floor under light load: an idle server answers a lone
+  request after at most this delay).
+
+One **batcher thread** owns the assembly loop: it blocks for the first
+pending request, then gathers more until the batch is full or the delay
+budget is spent, pads the assembled rows up to the next rung of the bucket
+ladder (:func:`batch_buckets` — the ``bridge.batching.bucket_size`` ladder
+from 1), and runs the model runtime's compiled predict exactly once for
+the whole batch.  Bucketing keeps the set of compiled shapes logarithmic
+in ``max_batch``; warmup compiles all of them at load, so steady-state
+requests never pay XLA compilation.
+
+Failure discipline (the chaos suite drives these paths):
+
+- a predict failure fails **that batch's** requests with a structured 503
+  (:class:`~.errors.PredictFailed`, Retry-After 1) and the loop continues
+  — one poisoned batch cannot take the server down;
+- the batcher thread itself is crash-ferried: an escape from the loop body
+  is recorded, pending requests are failed structurally, and the next
+  ``submit`` restarts the thread (self-healing, same discipline as the
+  PR 4 process-pool);
+- shutdown fails queued-but-unbatched requests with ``Overloaded
+  (shutting_down)`` rather than leaving their futures hanging.
+
+Fault sites: ``serve.queue`` fires once per batch assembly (a ``stall``
+models a stuck consumer — the queue backs up and admission starts
+shedding); ``serve.predict`` fires before the model call (``error`` models
+a killed predict worker).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.bridge.batching import bucket_size
+from dmlc_core_tpu.serve.admission import AdmissionController
+from dmlc_core_tpu.serve.errors import BadRequest, Overloaded, PredictFailed
+from dmlc_core_tpu.serve.model_runtime import ModelRuntime
+from dmlc_core_tpu.telemetry import clock
+from dmlc_core_tpu.utils.logging import log_error, log_warning
+
+__all__ = ["MicroBatcher", "batch_buckets"]
+
+# histogram bounds for batch row counts (powers of two up to the practical
+# serving range; the registry adds +Inf)
+_BATCH_ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+def batch_buckets(max_batch: int) -> List[int]:
+    """Ascending bucket ladder ``[1, 2, 3, 4, 6, 8, ...]`` capped so the
+    largest rung is exactly ``max_batch`` (every padded shape the scheduler
+    can emit, i.e. every shape warmup must compile)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out: List[int] = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b = bucket_size(b + 1, minimum=b)
+    out.append(max_batch)
+    return out
+
+
+class _Pending:
+    """One admitted request riding the queue toward a batch."""
+
+    __slots__ = ("rows", "future", "nbytes", "enqueued_at")
+
+    def __init__(self, rows: np.ndarray, future, nbytes: int, now: float):
+        self.rows = rows
+        self.future = future
+        self.nbytes = nbytes
+        self.enqueued_at = now
+
+
+class MicroBatcher:
+    """Request coalescer + the single predict consumer thread."""
+
+    def __init__(self, runtime: ModelRuntime, *, max_batch: int = 64,
+                 max_delay_ms: float = 2.0,
+                 admission: Optional[AdmissionController] = None):
+        if max_delay_ms < 0:
+            raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        self.runtime = runtime
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.admission = admission or AdmissionController()
+        self.buckets = batch_buckets(self.max_batch)
+        self._queue: "queue.Queue[_Pending]" = queue.Queue()
+        self._carry: Optional[_Pending] = None  # overflow from last assembly
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # reentrant: _ensure_thread locks for itself AND is called from
+        # submit()'s stop-check/enqueue critical section
+        self._thread_lock = threading.RLock()
+        self._crash: Optional[BaseException] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._ensure_thread()
+
+    def _ensure_thread(self) -> None:
+        with self._thread_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            self._crash = None
+            self._thread = threading.Thread(
+                target=self._loop, name="serve-batcher", daemon=False)
+            self._thread.start()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the batcher and fail anything still queued (structured)."""
+        self._stop.set()
+        with self._thread_lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                log_warning("serve-batcher did not stop within "
+                            f"{timeout}s; abandoning it")
+        self._drain_failed(Overloaded("server shutting down",
+                                      retry_after=5.0), reason="shutdown")
+
+    def _drain_failed(self, exc: Exception, *, reason: str) -> None:
+        pending = []
+        if self._carry is not None:
+            pending.append(self._carry)
+            self._carry = None
+        while True:
+            try:
+                pending.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if pending:
+            self.admission.release(sum(i.nbytes for i in pending))
+            for item in pending:
+                _fail_future(item.future, exc)
+            telemetry.count("dmlc_serve_shed_total", len(pending),
+                            reason=reason)
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, rows: np.ndarray):
+        """Admit + enqueue ``rows`` ([n, F] float32); returns the Future
+        resolving to this request's ``[n]``/``[n, K]`` predictions.
+
+        Raises the structured rejections directly: ``BadRequest`` on a
+        contract violation, ``Overloaded`` from admission.
+        """
+        rows = np.ascontiguousarray(rows, dtype=np.float32)
+        if rows.ndim != 2 or rows.shape[1] != self.runtime.num_feature:
+            raise BadRequest(
+                f"instances must be [n, {self.runtime.num_feature}] "
+                f"(got shape {tuple(rows.shape)})")
+        n = rows.shape[0]
+        if n == 0:
+            raise BadRequest("empty instances")
+        if n > self.max_batch:
+            raise BadRequest(
+                f"{n} instances exceed max_batch={self.max_batch}; "
+                "split the request",
+                details={"max_batch": self.max_batch})
+        if self._crash is not None:
+            # the previous thread died outside the per-batch guard: surface
+            # once, then self-heal below
+            log_warning(f"serve-batcher restarting after crash: "
+                        f"{self._crash!r}")
+        self.admission.try_admit(rows.nbytes)
+        from concurrent.futures import Future
+
+        item = _Pending(rows, Future(), rows.nbytes, clock.monotonic())
+        with self._thread_lock:
+            if self._stop.is_set():
+                self.admission.release(item.nbytes)
+                telemetry.count("dmlc_serve_shed_total", reason="shutdown")
+                raise Overloaded("server shutting down", retry_after=5.0)
+            self._ensure_thread()  # self-heal a dead batcher
+            # enqueue under the lock: a put after close()'s drain would
+            # strand this item (future unresolved, bytes leaked)
+            self._queue.put(item)
+        telemetry.gauge_set("dmlc_serve_queue_depth", self._queue.qsize())
+        return item.future
+
+    # -- consumer side -------------------------------------------------------
+
+    def _loop(self) -> None:
+        # the whole-target try/except is the lockset-thread-leak discipline:
+        # nothing may escape a serving thread silently
+        try:
+            self._run()
+        except BaseException as exc:  # noqa: BLE001 — ferried, not swallowed
+            log_error(f"serve-batcher crashed: {exc!r}")
+            telemetry.count("dmlc_serve_batcher_crashes_total")
+            # deregister + drain under the lock: a racing submit() either
+            # lands before the drain (failed structurally here) or after
+            # it, when _ensure_thread sees no thread and starts a fresh
+            # batcher to consume it — nothing can strand in between
+            with self._thread_lock:
+                self._crash = exc
+                if self._thread is threading.current_thread():
+                    self._thread = None
+                self._drain_failed(PredictFailed(
+                    f"scoring backend crashed: {exc}", retry_after=2.0),
+                    reason="predict_failed")
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self._assemble()
+            if batch:
+                self._run_batch(batch)
+
+    def _assemble(self) -> List[_Pending]:
+        """Block for the first request, then gather until full or the
+        delay budget is spent.  An item that would overflow ``max_batch``
+        carries over as the seed of the next batch.
+
+        Crash-safe: requests already popped when an assembly fault fires
+        are failed structurally before the crash ferries out — a popped
+        item whose future never resolves would hang its client until the
+        request timeout for no reason.
+        """
+        batch: List[_Pending] = []
+        try:
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    return []
+            batch.append(first)
+            # a stalled consumer: the one fault that makes admission shed
+            fault.inject("serve.queue", depth=self._queue.qsize())
+            rows = first.rows.shape[0]
+            deadline = clock.monotonic() + self.max_delay_s
+            while rows < self.max_batch:
+                remaining = deadline - clock.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if rows + item.rows.shape[0] > self.max_batch:
+                    self._carry = item
+                    break
+                batch.append(item)
+                rows += item.rows.shape[0]
+        except BaseException as exc:
+            failure = PredictFailed(f"batch assembly failed: {exc}",
+                                    retry_after=2.0)
+            telemetry.count("dmlc_serve_shed_total", len(batch),
+                            reason="predict_failed")
+            if batch:
+                self.admission.release(sum(i.nbytes for i in batch))
+            for item in batch:
+                _fail_future(item.future, failure)
+            raise
+        telemetry.gauge_set("dmlc_serve_queue_depth", self._queue.qsize())
+        return batch
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        n = sum(item.rows.shape[0] for item in batch)
+        bucket = self.buckets[-1] if n >= self.max_batch \
+            else next(b for b in self.buckets if b >= n)
+        now = clock.monotonic()
+        for item in batch:
+            telemetry.observe("dmlc_serve_queue_seconds",
+                              now - item.enqueued_at)
+        try:
+            with telemetry.span("serve.batch", rows=n, bucket=bucket,
+                                requests=len(batch)):
+                x = np.zeros((bucket, self.runtime.num_feature), np.float32)
+                ofs = 0
+                for item in batch:
+                    x[ofs:ofs + item.rows.shape[0]] = item.rows
+                    ofs += item.rows.shape[0]
+                fault.inject("serve.predict", model=self.runtime.name,
+                             rows=n)
+                t0 = clock.monotonic()
+                with telemetry.span("serve.predict",
+                                    model=self.runtime.name, bucket=bucket):
+                    y = self.runtime.predict(x)
+                telemetry.observe("dmlc_serve_predict_seconds",
+                                  clock.monotonic() - t0,
+                                  model=self.runtime.name)
+        except Exception as exc:
+            telemetry.count("dmlc_serve_predict_errors_total",
+                            model=self.runtime.name)
+            telemetry.count("dmlc_serve_shed_total", len(batch),
+                            reason="predict_failed")
+            log_error(f"serve: predict failed for a {n}-row batch: {exc!r}")
+            failure = PredictFailed(f"predict failed: {exc}")
+            self.admission.release(sum(i.nbytes for i in batch))
+            for item in batch:
+                _fail_future(item.future, failure)
+            return
+        telemetry.count("dmlc_serve_batches_total")
+        telemetry.count("dmlc_serve_rows_total", n)
+        telemetry.observe("dmlc_serve_batch_rows", n,
+                          buckets=_BATCH_ROW_BUCKETS)
+        # one release per batch: the admission drain-rate estimate samples
+        # real consumption, not the microsecond spacing of a per-item loop
+        self.admission.release(sum(i.nbytes for i in batch))
+        ofs = 0
+        for item in batch:
+            k = item.rows.shape[0]
+            _set_future(item.future, np.asarray(y[ofs:ofs + k]))
+            ofs += k
+
+
+def _set_future(future, value) -> None:
+    try:
+        future.set_result(value)
+    except Exception:  # already cancelled/timed out: the answer has no taker
+        pass
+
+
+def _fail_future(future, exc: Exception) -> None:
+    try:
+        future.set_exception(exc)
+    except Exception:
+        pass

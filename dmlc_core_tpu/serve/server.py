@@ -1,0 +1,384 @@
+"""The scoring transport: stdlib HTTP in front of the micro-batcher.
+
+Endpoints (all JSON):
+
+- ``POST /v1/score`` — ``{"instances": [row, ...]}`` where a row is either
+  a dense ``[f0, f1, ...]`` list of ``num_feature`` numbers or a sparse
+  ``{"index": [...], "value": [...]}`` pair (feature ids in
+  ``[0, num_feature)``); answers ``{"predictions": [...], "model": ...,
+  "num_rows": n}`` or a structured error envelope (:mod:`.errors`);
+- ``GET /healthz`` — liveness + model identity;
+- ``GET /metrics`` — the telemetry registry in Prometheus text form;
+- ``GET /stats`` — the serving SLO snapshot: per-histogram count/mean and
+  p50/p95/p99 derived via :func:`dmlc_core_tpu.telemetry.report.
+  estimate_quantiles` (the same math the offline report uses).
+
+Every request runs inside a ``serve.request`` telemetry span and lands in
+``dmlc_serve_request_seconds{status=...}``; the ``serve.request`` fault
+site fires before parsing (``http_status`` rules *replace* the response —
+the chaos 503 storm — act rules model slow/broken connections).
+
+The server is ``ThreadingHTTPServer``: one handler thread per connection,
+all funneling into the single batcher thread — concurrency without a
+thread-per-request predict path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.serve.admission import (AdmissionController,
+                                           queue_bytes_from_env)
+from dmlc_core_tpu.serve.errors import (BadRequest, RequestTimeout,
+                                        ServeError)
+from dmlc_core_tpu.serve.model_runtime import ModelRuntime
+from dmlc_core_tpu.serve.scheduler import MicroBatcher
+from dmlc_core_tpu.telemetry import clock
+from dmlc_core_tpu.telemetry.report import (REPORT_QUANTILES, _label_str,
+                                            estimate_quantiles)
+from dmlc_core_tpu.utils.logging import log_debug, log_info, log_warning
+
+__all__ = ["ScoringServer", "parse_instances"]
+
+MAX_BODY_BYTES = 8 << 20  # one request, not a bulk upload
+
+
+def parse_instances(obj: Any, num_feature: int) -> np.ndarray:
+    """Validate + densify a ``{"instances": [...]}`` body to [n, F] f32.
+
+    Every malformed shape raises :class:`BadRequest` naming the offending
+    row — a scoring client debugging a 400 should never need server logs.
+    """
+    if not isinstance(obj, dict):
+        raise BadRequest("body must be a JSON object")
+    instances = obj.get("instances")
+    if not isinstance(instances, list) or not instances:
+        raise BadRequest("'instances' must be a non-empty list")
+    out = np.zeros((len(instances), num_feature), np.float32)
+    for i, row in enumerate(instances):
+        if isinstance(row, list):
+            if len(row) != num_feature:
+                raise BadRequest(
+                    f"instances[{i}]: expected {num_feature} features, "
+                    f"got {len(row)}")
+            try:
+                out[i] = np.asarray(row, dtype=np.float32)
+            except (TypeError, ValueError):
+                raise BadRequest(
+                    f"instances[{i}]: non-numeric feature value") from None
+            if not np.isfinite(out[i]).all():
+                # json.loads admits 1e400/NaN; letting them through would
+                # end in a 200 whose body strict JSON parsers reject
+                raise BadRequest(
+                    f"instances[{i}]: non-finite feature value")
+        elif isinstance(row, dict):
+            idx, val = row.get("index"), row.get("value")
+            if not isinstance(idx, list) or not isinstance(val, list) \
+                    or len(idx) != len(val):
+                raise BadRequest(
+                    f"instances[{i}]: sparse rows need equal-length "
+                    "'index' and 'value' lists")
+            try:
+                ids = np.asarray(idx, dtype=np.int64)
+                vals = np.asarray(val, dtype=np.float32)
+                # np.asarray silently truncates 1.7 -> 1: a float feature
+                # id is a client bug that must 400, not mis-route a value
+                if not np.array_equal(np.asarray(idx, dtype=np.float64),
+                                      ids):
+                    raise BadRequest(
+                        f"instances[{i}]: non-integer feature index")
+            except (TypeError, ValueError):
+                raise BadRequest(
+                    f"instances[{i}]: non-numeric index/value") from None
+            if vals.size and not np.isfinite(vals).all():
+                raise BadRequest(
+                    f"instances[{i}]: non-finite feature value")
+            if ids.size and (ids.min() < 0 or ids.max() >= num_feature):
+                raise BadRequest(
+                    f"instances[{i}]: feature index out of "
+                    f"[0, {num_feature})")
+            out[i, ids] = vals
+        else:
+            raise BadRequest(
+                f"instances[{i}]: each row must be a list of "
+                f"{num_feature} numbers or a sparse index/value object")
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dmlc-serve/0.1"
+    protocol_version = "HTTP/1.1"
+    # per-socket deadline: a client announcing more body bytes than it
+    # sends (or idling mid-request) must not pin a handler thread forever
+    # — the same discipline as DMLC_TRACKER_SOCK_TIMEOUT on the tracker
+    timeout = 30.0
+
+    # the app (ScoringServer) rides on the HTTPServer instance
+    @property
+    def app(self) -> "ScoringServer":
+        return self.server.app  # type: ignore[attr-defined]
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        # BaseHTTPRequestHandler prints to stderr; route through the
+        # repo's logging (and keep per-request lines at debug verbosity)
+        log_debug(2, f"serve: {self.address_string()} {fmt % args}")
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _respond(self, status: int, body: bytes,
+                 headers: Optional[Dict[str, str]] = None,
+                 content_type: str = "application/json") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            if k.lower() not in ("content-type", "content-length"):
+                self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _respond_json(self, status: int, payload: Dict[str, Any],
+                      headers: Optional[Dict[str, str]] = None) -> None:
+        self._respond(status, json.dumps(payload, sort_keys=True).encode(),
+                      headers)
+
+    def _respond_error(self, exc: ServeError) -> None:
+        self._respond(exc.status, exc.body(), exc.headers())
+
+    # -- routes ---------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server contract)
+        app = self.app
+        if self.path == "/healthz":
+            self._respond_json(200, {
+                "status": "ok", "model": app.runtime.name,
+                "num_feature": app.runtime.num_feature,
+                "max_batch": app.batcher.max_batch,
+                "uptime_s": round(clock.monotonic() - app.started_at, 3)})
+        elif self.path == "/metrics":
+            self._respond(200, telemetry.prometheus_text().encode(),
+                          content_type="text/plain; version=0.0.4")
+        elif self.path == "/stats":
+            self._respond_json(200, app.stats())
+        else:
+            self._respond_error(BadRequest(f"no such path {self.path!r}"))
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server contract)
+        if self.path != "/v1/score":
+            self._respond_error(BadRequest(f"no such path {self.path!r}"))
+            return
+        app = self.app
+        t0 = clock.monotonic()
+        status = 500
+        try:
+            with telemetry.span("serve.request"):
+                injected = fault.http_response("serve.request")
+                if injected is not None:
+                    i_status, i_headers, i_body = injected
+                    status = i_status
+                    if status == 503:
+                        telemetry.count("dmlc_serve_shed_total",
+                                        reason="injected_503")
+                    # the request body was never read: keeping this
+                    # keep-alive connection would parse it as the next
+                    # request line
+                    self.close_connection = True
+                    self._respond(status, i_body or b'{"error": '
+                                  b'{"code": "injected"}}', i_headers)
+                    return
+                # act kinds: delay/stall = a slow server thread; reset =
+                # the connection dying mid-request (the one outcome a
+                # client counts as crashed)
+                fault.inject("serve.request")
+                status, payload, headers = self._score(app)
+                self._respond_json(status, payload, headers)
+        except ServeError as exc:
+            status = exc.status
+            self._respond_error(exc)
+        except (BrokenPipeError, ConnectionResetError):
+            # client (or an injected reset) tore the socket down: there is
+            # no one left to answer — close, count, survive
+            status = 0
+            telemetry.count("dmlc_serve_connection_aborts_total")
+            self.close_connection = True
+        except Exception as exc:  # noqa: BLE001 — the 500 of last resort
+            status = 500
+            log_warning(f"serve: unexpected error handling request: {exc!r}")
+            # the body may be partially read or unread here: keeping the
+            # keep-alive connection would desync its framing (same reason
+            # every early-response path above closes)
+            self.close_connection = True
+            try:
+                self._respond_error(ServeError(f"internal error: {exc}"))
+            except OSError:
+                pass
+        finally:
+            telemetry.count("dmlc_serve_requests_total", status=status)
+            telemetry.observe("dmlc_serve_request_seconds",
+                              clock.monotonic() - t0, status=status)
+
+    def _score(self, app: "ScoringServer") \
+            -> Tuple[int, Dict[str, Any], Optional[Dict[str, str]]]:
+        try:
+            length = int(self.headers.get("Content-Length", ""))
+        except ValueError:
+            self.close_connection = True  # unread body would desync keep-alive
+            raise BadRequest("Content-Length required") from None
+        if length < 0:
+            # rfile.read(-1) would block until client EOF — a hostile
+            # header must not pin a handler thread
+            self.close_connection = True
+            raise BadRequest(f"invalid Content-Length {length}")
+        if length > MAX_BODY_BYTES:
+            # responding without draining would desync this keep-alive
+            # connection; the body is too big to drain, so drop the link
+            self.close_connection = True
+            exc = BadRequest(
+                f"body of {length} bytes exceeds {MAX_BODY_BYTES}")
+            exc.status = 413
+            exc.code = "payload_too_large"
+            raise exc
+        raw = self.rfile.read(length)
+        try:
+            obj = json.loads(raw)
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise BadRequest(f"body is not valid JSON: {e}") from None
+        rows = parse_instances(obj, app.runtime.num_feature)
+        future = app.batcher.submit(rows)
+        try:
+            preds = future.result(timeout=app.request_timeout_s)
+        except FutureTimeout:
+            telemetry.count("dmlc_serve_shed_total", reason="timeout")
+            raise RequestTimeout(
+                f"not answered within {app.request_timeout_s}s "
+                "(queue + predict)", details={
+                    "timeout_s": app.request_timeout_s}) from None
+        preds = np.asarray(preds)
+        if not np.isfinite(preds).all():
+            # finite inputs produced a non-finite score (model overflow):
+            # a structured 500 beats a 200 body of RFC-invalid Infinity
+            raise ServeError("model produced a non-finite prediction")
+        return 200, {"predictions": preds.tolist(),
+                     "model": app.runtime.name,
+                     "num_rows": int(rows.shape[0])}, None
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True       # handler threads must not block shutdown
+    allow_reuse_address = True
+
+    def handle_error(self, request, client_address) -> None:
+        # default prints a traceback to stderr per dropped connection —
+        # under an injected reset storm that is pure noise
+        log_debug(1, f"serve: connection error from {client_address}")
+
+
+class ScoringServer:
+    """The assembled service: runtime + batcher + admission + transport."""
+
+    def __init__(self, runtime: ModelRuntime, *, host: str = "127.0.0.1",
+                 port: int = 0, max_batch: int = 64,
+                 max_delay_ms: float = 2.0,
+                 max_queue_bytes: Optional[int] = None,
+                 request_timeout_s: float = 10.0, warmup: bool = True):
+        self.runtime = runtime
+        self.request_timeout_s = float(request_timeout_s)
+        self._warmup = warmup
+        self.admission = AdmissionController(
+            max_queue_bytes if max_queue_bytes is not None
+            else queue_bytes_from_env())
+        self.batcher = MicroBatcher(runtime, max_batch=max_batch,
+                                    max_delay_ms=max_delay_ms,
+                                    admission=self.admission)
+        self._httpd = _Server((host, port), _Handler)
+        self._httpd.app = self  # type: ignore[attr-defined]
+        self._serve_thread: Optional[threading.Thread] = None
+        self.started_at = clock.monotonic()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ScoringServer":
+        if self._warmup:
+            self.runtime.warmup(self.batcher.buckets)
+        self.batcher.start()
+        self.started_at = clock.monotonic()
+        self._serve_thread = threading.Thread(
+            target=self._serve, name="serve-http", daemon=False)
+        self._serve_thread.start()
+        log_info(f"serve: listening on {self.url} "
+                 f"(model={self.runtime.name}, "
+                 f"max_batch={self.batcher.max_batch}, "
+                 f"max_delay_ms={self.batcher.max_delay_s * 1e3:g}, "
+                 f"max_queue_bytes={self.admission.max_queue_bytes})")
+        return self
+
+    def _serve(self) -> None:
+        try:
+            self._httpd.serve_forever(poll_interval=0.1)
+        except Exception as exc:  # noqa: BLE001 — ferried, not swallowed
+            log_warning(f"serve: listener exited abnormally: {exc!r}")
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(10.0)
+            self._serve_thread = None
+        self._httpd.server_close()
+        self.batcher.close()
+
+    def __enter__(self) -> "ScoringServer":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- the SLO snapshot -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Live serving stats: counters + histogram quantiles, the same
+        estimates the offline ``telemetry report`` prints."""
+        out: Dict[str, Any] = {
+            "model": self.runtime.name,
+            "queue_bytes": self.admission.queued_bytes,
+            "max_queue_bytes": self.admission.max_queue_bytes,
+            "uptime_s": round(clock.monotonic() - self.started_at, 3),
+            "metrics": {},
+        }
+        for fam in telemetry.get_registry().families():
+            if not fam.name.startswith("dmlc_serve_"):
+                continue
+            for key, child in fam.samples():
+                # the same renderer the offline report uses, so /stats
+                # series names join 1:1 against the aggregated table
+                series = fam.name + _label_str(dict(key))
+                if fam.kind == "counter":
+                    out["metrics"][series] = child.value
+                elif fam.kind == "gauge":
+                    out["metrics"][series] = child.value
+                else:
+                    counts = child.bucket_counts
+                    ests = estimate_quantiles(
+                        child.buckets, counts,
+                        [q for _, q in REPORT_QUANTILES])
+                    entry: Dict[str, Any] = {
+                        "count": child.count,
+                        "mean": (child.sum / child.count
+                                 if child.count else None)}
+                    for (name, _), est in zip(REPORT_QUANTILES, ests):
+                        entry[name] = est
+                    out["metrics"][series] = entry
+        return out

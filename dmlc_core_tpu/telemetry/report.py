@@ -4,6 +4,11 @@ Each rank flushing into a shared ``DMLC_TELEMETRY_DIR`` leaves one
 ``metrics-r<rank>-p<pid>.json`` snapshot.  This module folds them back into
 one table: counters and histograms sum across ranks; gauges keep per-rank
 spread (min/max) because summing queue depths across ranks is meaningless.
+
+Histograms additionally get **quantile estimates** (p50/p95/p99) derived
+from the merged fixed-bucket counts (:func:`estimate_quantiles`): serving
+SLOs are stated as latency quantiles, and a report that only shows bucket
+counts makes every reader redo the interpolation by hand.
 """
 
 from __future__ import annotations
@@ -11,9 +16,58 @@ from __future__ import annotations
 import glob
 import json
 import os
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["load_snapshots", "aggregate", "render_table", "main"]
+__all__ = ["load_snapshots", "aggregate", "estimate_quantiles",
+           "render_table", "main"]
+
+# the quantiles every aggregated histogram reports (SLO vocabulary)
+REPORT_QUANTILES: Tuple[Tuple[str, float], ...] = (
+    ("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+
+def estimate_quantiles(buckets: Sequence[float], counts: Sequence[int],
+                       qs: Sequence[float]) -> List[Optional[float]]:
+    """Quantile estimates from fixed-bucket histogram counts.
+
+    ``buckets`` are the finite inclusive upper bounds (ascending);
+    ``counts`` are **non-cumulative** per-bucket counts with one extra
+    trailing entry for the implicit +Inf bucket (the registry's
+    ``bucket_counts`` layout).  Returns one estimate per ``q`` in ``qs``:
+
+    - linear interpolation inside the bucket the quantile rank lands in,
+      taking the previous bound (or 0.0 for the first bucket — observations
+      here are non-negative latencies/sizes) as the lower edge;
+    - a rank landing in the +Inf bucket reports the highest finite bound
+      (the Prometheus ``histogram_quantile`` convention: the estimate is a
+      floor, not an extrapolation past what the buckets can resolve);
+    - ``None`` per quantile when the histogram is empty or the counts
+      don't line up with the bounds (a cross-rank bucket clash).
+    """
+    bounds = [float(b) for b in buckets]
+    if len(counts) != len(bounds) + 1 or not bounds:
+        return [None] * len(qs)
+    total = sum(counts)
+    if total <= 0:
+        return [None] * len(qs)
+    out: List[Optional[float]] = []
+    for q in qs:
+        if not 0.0 <= q <= 1.0:
+            out.append(None)
+            continue
+        rank = q * total
+        running = 0.0
+        est: Optional[float] = bounds[-1]  # +Inf bucket floors here
+        for i, c in enumerate(counts[:-1]):
+            if running + c >= rank:
+                lo = 0.0 if i == 0 else bounds[i - 1]
+                hi = bounds[i]
+                # position within this bucket's count mass
+                est = lo + (hi - lo) * ((rank - running) / c) if c else hi
+                break
+            running += c
+        out.append(est)
+    return out
 
 
 def load_snapshots(dirpath: str) -> List[Dict[str, Any]]:
@@ -85,12 +139,26 @@ def aggregate(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
                             entry["bucket_clash"] = True
                         else:
                             entry["counts"] = [a + b for a, b in zip(prev, counts)]
-                    if entry.get("count"):
-                        entry["mean"] = entry["sum"] / entry["count"]
+    # finalize histograms once per merged series, not once per folded
+    # snapshot: mean + quantile estimates only make sense on the final fold
+    for entry in merged.values():
+        if entry["kind"] != "histogram":
+            continue
+        if entry.get("count"):
+            entry["mean"] = entry["sum"] / entry["count"]
+        if entry.get("counts") and entry.get("buckets"):
+            # quantiles follow the merged bucket counts (on a bucket
+            # clash they cover the folded ranks only — the clash marker
+            # above says so)
+            ests = estimate_quantiles(entry["buckets"], entry["counts"],
+                                      [q for _, q in REPORT_QUANTILES])
+            for (name, _), est in zip(REPORT_QUANTILES, ests):
+                if est is not None:
+                    entry[name] = est
     return merged
 
 
-def _value_column(entry: Dict[str, Any]) -> str:
+def _value_column(entry: Dict[str, Any], series: str = "") -> str:
     kind = entry["kind"]
     if kind == "counter":
         total = entry.get("total", 0.0)
@@ -100,9 +168,19 @@ def _value_column(entry: Dict[str, Any]) -> str:
         if lo == hi:
             return f"{lo:.6g}"
         return f"min={lo:.6g} max={hi:.6g}"
+    # the "s" unit suffix follows the catalog convention: only *_seconds
+    # histograms measure durations (dmlc_serve_batch_rows is a count)
+    unit = "s" if series.split("{", 1)[0].endswith("_seconds") else ""
     mean = entry.get("mean")
-    mean_s = f" mean={mean:.6g}s" if mean is not None else ""
-    return f"n={entry.get('count', 0)} sum={entry.get('sum', 0.0):.6g}{mean_s}"
+    mean_s = f" mean={mean:.6g}{unit}" if mean is not None else ""
+    q_s = "".join(f" {name}={entry[name]:.6g}{unit}"
+                  for name, _ in REPORT_QUANTILES if name in entry)
+    # a clash fold is partial: say so next to the numbers it limits
+    # (count/sum still cover every rank; counts-derived quantiles don't)
+    flag = (" [bucket-clash: quantiles cover first-fold ranks only]"
+            if entry.get("bucket_clash") else "")
+    return (f"n={entry.get('count', 0)} sum={entry.get('sum', 0.0):.6g}"
+            f"{mean_s}{q_s}{flag}")
 
 
 def render_table(merged: Dict[str, Any]) -> str:
@@ -113,7 +191,8 @@ def render_table(merged: Dict[str, Any]) -> str:
         ranks = sorted(set(entry.get("ranks", [])))
         rank_s = ",".join(map(str, ranks)) if len(ranks) <= 6 \
             else f"{len(ranks)} ranks"
-        rows.append((series, entry["kind"], rank_s, _value_column(entry)))
+        rows.append((series, entry["kind"], rank_s,
+                     _value_column(entry, series)))
     widths = [max(len(r[i]) for r in rows) for i in range(3)]
     lines = []
     for i, row in enumerate(rows):

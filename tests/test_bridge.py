@@ -351,3 +351,92 @@ def test_num_rows_is_static_under_jit(tmp_path):
     # structure round-trips through tree_map with aux preserved
     b2 = jax.tree_util.tree_map(lambda a: a, b)
     assert b2.num_rows == 3
+
+
+# -- final-partial-batch semantics (the serving scheduler depends on these) ---
+
+def test_bucket_ladder_from_one():
+    # minimum=1 is the serving batch ladder; it must terminate and ascend
+    assert bucket_size(1, minimum=1) == 1
+    assert bucket_size(2, minimum=1) == 2
+    assert bucket_size(5, minimum=1) == 6
+    ladder = [bucket_size(n, minimum=1) for n in range(1, 65)]
+    assert ladder == sorted(ladder)
+    assert set(ladder) == {1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64}
+
+
+def test_dense_batches_remainder_mask_and_num_rows(tmp_path):
+    uri = write_libsvm(tmp_path, 10)
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    batches = list(dense_batches(parser, batch_size=4, num_feature=4))
+    assert [b.num_rows for b in batches] == [4, 4, 2]
+    tail = batches[-1]
+    assert tail.x.shape == (4, 4)                      # static shape held
+    np.testing.assert_allclose(tail.weight, [1, 1, 0, 0])
+    np.testing.assert_allclose(tail.label[2:], 0.0)
+    np.testing.assert_allclose(tail.x[2:], 0.0)        # padding zeroed
+    # real rows kept their features (rows 8 and 9 of the corpus)
+    np.testing.assert_allclose(tail.x[:2, 0], [8.0, 9.0])
+
+
+def test_dense_batches_drop_remainder_drops_short_tail(tmp_path):
+    uri = write_libsvm(tmp_path, 10)
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    batches = list(dense_batches(parser, batch_size=4, num_feature=4,
+                                 drop_remainder=True))
+    assert [b.num_rows for b in batches] == [4, 4]
+
+
+def test_sparse_batches_remainder_mask(tmp_path):
+    uri = write_libsvm(tmp_path, 6)
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    batches = list(sparse_batches(parser, batch_size=4, nnz_bucket=16))
+    assert [b.num_rows for b in batches] == [4, 2]
+    tail = batches[-1]
+    assert tail.label.shape == (4,) and tail.value.shape == (16,)
+    np.testing.assert_allclose(tail.weight, [1, 1, 0, 0])
+    # padding nnz slots route to the drop segment (row_id == batch_size)
+    real_nnz = 4  # 2 rows x 2 features in the corpus
+    assert (tail.row_id[real_nnz:] == 4).all()
+    seg = jax.ops.segment_sum(jnp.asarray(tail.value),
+                              jnp.asarray(tail.row_id), num_segments=5)
+    assert float(seg[2]) == 0.0 and float(seg[3]) == 0.0
+
+
+def test_batches_empty_parser_yields_nothing(tmp_path):
+    # a parser with no rows (blank-line-only file: the input split rejects
+    # zero-byte files outright) must yield no batches — never an
+    # all-padding one
+    p = tmp_path / "empty.libsvm"
+    p.write_text("\n\n")
+    parser = create_parser(str(p), type="libsvm", threaded=False)
+    assert list(dense_batches(parser, batch_size=4, num_feature=4)) == []
+    parser = create_parser(str(p), type="libsvm", threaded=False)
+    assert list(sparse_batches(parser, batch_size=4, nnz_bucket=8)) == []
+    # same contract for a block stream that is empty altogether
+    assert list(dense_batches(iter(()), batch_size=4, num_feature=4)) == []
+
+
+def test_batches_batch_size_one(tmp_path):
+    uri = write_libsvm(tmp_path, 3)
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    batches = list(dense_batches(parser, batch_size=1, num_feature=4))
+    assert [b.num_rows for b in batches] == [1, 1, 1]
+    for i, b in enumerate(batches):
+        assert b.x.shape == (1, 4)
+        np.testing.assert_allclose(b.x[0, 0], float(i))
+        np.testing.assert_allclose(b.weight, [1.0])
+
+
+def test_dense_batches_remainder_keeps_explicit_weights(tmp_path):
+    # explicit libsvm row weights (label:weight) must survive into the
+    # masked tail: weight-0 padding is the mask, not a rescale of real
+    # rows — which is exactly why num_rows, not weight.sum(), is the
+    # true row count
+    p = tmp_path / "weighted.libsvm"
+    p.write_text("\n".join(f"{i % 2}:2.5 0:{i} 3:1.0" for i in range(3))
+                 + "\n")
+    parser = create_parser(str(p), type="libsvm", threaded=False)
+    batches = list(dense_batches(parser, batch_size=2, num_feature=4))
+    assert [b.num_rows for b in batches] == [2, 1]
+    np.testing.assert_allclose(batches[-1].weight, [2.5, 0.0])

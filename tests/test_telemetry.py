@@ -489,3 +489,74 @@ def test_report_warns_on_duplicate_rank_snapshots(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "multiple snapshots" in out
     assert "15" in out  # still sums — the note explains, it doesn't hide
+
+
+# -- histogram quantile estimation (serving SLOs) ------------------------------
+
+def test_estimate_quantiles_uniform_counts_interpolate_exactly():
+    # 10 observations per decade bucket: quantile ranks land on bucket
+    # boundaries and interior points with closed-form expectations
+    buckets = (10.0, 20.0, 30.0, 40.0)
+    counts = [10, 10, 10, 10, 0]
+    p25, p50, p99 = report.estimate_quantiles(buckets, counts,
+                                              (0.25, 0.50, 0.99))
+    assert p25 == pytest.approx(10.0)
+    assert p50 == pytest.approx(20.0)
+    assert p99 == pytest.approx(39.6)
+
+
+def test_estimate_quantiles_first_bucket_lower_edge_is_zero():
+    # everything in the first bucket: interpolation starts at 0, not at the
+    # first bound (latency observations are non-negative)
+    (p50,) = report.estimate_quantiles((0.1, 1.0), [100, 0, 0], (0.5,))
+    assert p50 == pytest.approx(0.05)
+
+
+def test_estimate_quantiles_inf_bucket_floors_at_last_finite_bound():
+    # mass past the last finite bound cannot be resolved: the estimate
+    # reports the highest finite bound (histogram_quantile convention),
+    # never an invented extrapolation
+    buckets = (0.1, 1.0)
+    qs = report.estimate_quantiles(buckets, [0, 0, 7], (0.5, 0.99))
+    assert qs == [1.0, 1.0]
+    # mixed: p50 resolves inside the finite buckets, p99 floors
+    p50, p99 = report.estimate_quantiles(buckets, [6, 0, 4], (0.5, 0.99))
+    assert p50 == pytest.approx(0.1 * (5.0 / 6.0))
+    assert p99 == pytest.approx(1.0)
+
+
+def test_estimate_quantiles_degenerate_inputs_are_none():
+    assert report.estimate_quantiles((1.0,), [0, 0], (0.5,)) == [None]
+    # counts length not bounds+1 (a cross-rank bucket clash)
+    assert report.estimate_quantiles((1.0, 2.0), [1, 1], (0.5,)) == [None]
+    assert report.estimate_quantiles((), [], (0.5,)) == [None]
+    # out-of-range q
+    assert report.estimate_quantiles((1.0,), [3, 0], (1.5,)) == [None]
+
+
+def test_estimate_quantiles_tracks_numpy_percentile_within_bucket_width():
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    sample = rng.gamma(2.0, 0.05, size=5000)  # latency-shaped
+    bounds = tuple(np.linspace(0.01, 1.0, 100))
+    h = telemetry.Histogram(buckets=bounds)
+    for v in sample:
+        h.observe(v)
+    width = bounds[1] - bounds[0]
+    for q in (0.5, 0.95, 0.99):
+        (est,) = report.estimate_quantiles(bounds, h.bucket_counts, (q,))
+        assert abs(est - float(np.percentile(sample, q * 100))) <= width
+
+
+def test_report_aggregate_emits_quantiles(tmp_path):
+    _write_rank_snapshot(str(tmp_path), 0, 1, 0.0, [0.05] * 9)
+    _write_rank_snapshot(str(tmp_path), 1, 1, 0.0, [2.0])
+    merged = report.aggregate(report.load_snapshots(str(tmp_path)))
+    hist = merged["dmlc_collective_op_seconds"]
+    # 9 of 10 samples land <= 0.1, the last in +Inf: p50 interpolates in
+    # the first bucket, p99 floors at the last finite bound (1.0)
+    assert hist["p50"] == pytest.approx(0.1 * (5.0 / 9.0))
+    assert hist["p99"] == pytest.approx(1.0)
+    table = report.render_table(merged)
+    assert "p50=" in table and "p99=" in table
