@@ -24,6 +24,7 @@ on full fallback.  The JSON carries explicit "platform" and "tpu_available"
 fields so the driver can tell a real-chip number from a CPU fallback.
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -51,6 +52,60 @@ JSON_TAG = "DMLC_BENCH_JSON:"
 # back to the canonical repo-root location so the re-exec driver still works.
 SCRIPT_PATH = os.path.abspath(
     globals().get("__file__", os.path.join(os.getcwd(), "bench.py")))
+# Children flush telemetry + flight-recorder dumps here; on a child timeout
+# the parent reads the dumps back so the timeout says WHAT the child was
+# doing, not just that 300s elapsed (the r03-r05 CPU-fallback mystery).
+_TELEMETRY_DIR = os.environ.get("DMLC_TELEMETRY_DIR", "").strip()
+# flight dumps collected from timed-out children, attached to the emitted
+# JSON's detail so the evidence rides with the (fallback) measurement
+_TIMEOUT_FLIGHTS = []
+# the probe-run trace the children join (set by _trace_root for the span's
+# extent only — attempt() passes it per-child; mutating os.environ would
+# leak the finished trace into anything spawned after main() returns)
+_TRACEPARENT = None
+
+
+def telemetry_dir():
+    """The shared parent/children telemetry dir (created lazily)."""
+    global _TELEMETRY_DIR
+    if not _TELEMETRY_DIR:
+        _TELEMETRY_DIR = (os.path.join(STAGE_DIR, "telemetry") if STAGE_DIR
+                          else tempfile.mkdtemp(prefix="bench-telemetry-"))
+    os.makedirs(_TELEMETRY_DIR, exist_ok=True)
+    return _TELEMETRY_DIR
+
+
+def collect_flight(since, max_entries=30):
+    """Flight dumps written after ``since`` (a timed-out child's last
+    spans), trimmed to the newest ``max_entries`` events each."""
+    out = []
+    try:
+        paths = glob.glob(os.path.join(telemetry_dir(), "flight-*.json"))
+    except OSError:
+        return out
+    for path in sorted(paths):
+        try:
+            # 2s slack: coarse-mtime filesystems truncate st_mtime below a
+            # full-precision `since`; over-collecting a stale dump (it
+            # carries its own pid/reason) beats silently dropping the one
+            # this timeout produced
+            if os.path.getmtime(path) < since - 2.0:
+                continue
+            with open(path) as f:
+                dump = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        entries = dump.get("entries", [])[-max_entries:]
+        out.append({
+            "file": os.path.basename(path),
+            "reason": dump.get("reason"),
+            "pid": dump.get("pid"),
+            "last_events": [
+                {"name": e.get("name"), "ts": e.get("ts"),
+                 "dur": e.get("dur"), "args": e.get("args")}
+                for e in entries if isinstance(e, dict)],
+        })
+    return out
 
 
 def persist_stage(name, payload):
@@ -209,14 +264,25 @@ def _i8_state() -> bool:
 
 
 def run_probe():
-    """Child body: report which platform jax.devices() lands on."""
-    import jax
+    """Child body: report which platform jax.devices() lands on.
 
-    d = jax.devices()[0]
+    Each stage runs in its own span (joined to the parent's probe trace
+    via DMLC_TRACEPARENT): when this child times out, the flight recorder
+    leaves behind exactly which stage — plugin import, backend init, or
+    the first device op — ate the 300s.
+    """
+    from dmlc_core_tpu import telemetry
+
+    with telemetry.span("probe.import_jax"):
+        import jax
+
+    with telemetry.span("probe.backend_init"):
+        d = jax.devices()[0]
     # Touch the device so a half-alive tunnel fails here, not mid-benchmark.
     import jax.numpy as jnp
 
-    jnp.ones((8, 8)).block_until_ready()
+    with telemetry.span("probe.device_touch", platform=d.platform):
+        jnp.ones((8, 8)).block_until_ready()
     print(JSON_TAG + json.dumps({"platform": d.platform}), flush=True)
 
 
@@ -379,6 +445,16 @@ def attempt(mode, timeout_s):
     except ValueError:
         deadline = auto_deadline
     child_env = dict(os.environ, BENCH_CHILD_DEADLINE_S=str(deadline))
+    # observability contract with the child: it flushes telemetry + flight
+    # dumps where the parent can read them, its spans join the parent's
+    # probe-run trace (DMLC_TRACEPARENT set by main()), and the flight
+    # recorder re-dumps every few seconds so even a SIGKILLed child leaves
+    # an at-most-seconds-stale record of its last spans
+    child_env.setdefault("DMLC_TELEMETRY_DIR", telemetry_dir())
+    child_env.setdefault("DMLC_FLIGHT_INTERVAL_S", "5")
+    if _TRACEPARENT:
+        child_env.setdefault("DMLC_TRACEPARENT", _TRACEPARENT)
+    attempt_started = time.time()
     proc = subprocess.Popen(
         [sys.executable, SCRIPT_PATH, mode],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -403,13 +479,23 @@ def attempt(mode, timeout_s):
         raise
     if timed_out:
         # Surface the child's stage trail (log_stage markers) so the
-        # timeout says WHERE the budget went, not just that it ran out.
+        # timeout says WHERE the budget went, not just that it ran out —
+        # and collect the child's flight-recorder dump: its last recorded
+        # spans, written on SIGTERM (or every DMLC_FLIGHT_INTERVAL_S by
+        # the ring's background writer if the child was wedged in a C
+        # call and never ran the handler).
         trail = ((err or "") + (out or ""))[-1500:]
+        flight = collect_flight(attempt_started)
+        _TIMEOUT_FLIGHTS.append({"mode": mode, "timeout_s": timeout_s,
+                                 "flight": flight})
+        last = [e["name"] for d in flight
+                for e in d.get("last_events", [])][-8:]
         print(f"bench child {mode} timed out after {timeout_s}s; "
+              f"last flight-recorded spans: {last or 'none recovered'}; "
               f"child trail:\n{trail}", file=sys.stderr)
         persist_stage(_stage_name(mode),
                       {"error": f"timeout after {timeout_s}s",
-                       "child_trail": trail})
+                       "child_trail": trail, "flight": flight})
         return None
     for line in (out or "").splitlines():
         if line.startswith(JSON_TAG):
@@ -439,18 +525,57 @@ def _stage_name(mode):
     return f"attempt{mode.replace('-', '_')}_rows{N_ROWS}"
 
 
+def _trace_root():
+    """Root the probe run in one trace (parent span + DMLC_TRACEPARENT for
+    the children).  Returns a context manager; degrades to a no-op if the
+    package cannot import here — the parent's JSON-always contract must
+    survive a broken working directory."""
+    import contextlib
+
+    try:
+        from dmlc_core_tpu import telemetry
+        from dmlc_core_tpu.telemetry import tracecontext
+    except Exception as e:
+        print(f"bench: tracing unavailable in the parent ({e!r})",
+              file=sys.stderr)
+        return contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def rooted():
+        global _TRACEPARENT
+        telemetry.enable(telemetry_dir())
+        with tracecontext.activate(tracecontext.new_root()), \
+                telemetry.span("bench.run", rows=N_ROWS) as root:
+            _TRACEPARENT = tracecontext.format_traceparent(
+                tracecontext.TraceContext(root.trace_id, root.span_id))
+            try:
+                yield
+            finally:
+                _TRACEPARENT = None
+
+    return rooted()
+
+
 def main():
-    # Stage 1: cheap probe — does the accelerator backend init at all?  The
-    # tunneled TPU plugin can hang indefinitely, hence the subprocess timeout.
-    probe = attempt("--probe", PROBE_TIMEOUT_S)
-    accel_ok = probe is not None and probe.get("platform") not in (None, "cpu")
-    result = None
-    if accel_ok:
-        result = attempt("--child", ATTEMPT_TIMEOUT_S)
-    if result is None:
-        # CPU fallback — pins jax_platforms=cpu inside the child, so it is
-        # never blocked on the TPU plugin.
-        result = attempt("--child-cpu", ATTEMPT_TIMEOUT_S)
+    # The whole probe run is ONE trace: the parent records the root span,
+    # every child (probe, accel attempt, cpu fallback) continues it via
+    # DMLC_TRACEPARENT, and `python -m dmlc_core_tpu.telemetry trace <dir>`
+    # assembles the full timeline — including the flight-recorded tail of
+    # any child that timed out.
+    with _trace_root():
+        # Stage 1: cheap probe — does the accelerator backend init at all?
+        # The tunneled TPU plugin can hang indefinitely, hence the
+        # subprocess timeout.
+        probe = attempt("--probe", PROBE_TIMEOUT_S)
+        accel_ok = probe is not None \
+            and probe.get("platform") not in (None, "cpu")
+        result = None
+        if accel_ok:
+            result = attempt("--child", ATTEMPT_TIMEOUT_S)
+        if result is None:
+            # CPU fallback — pins jax_platforms=cpu inside the child, so it
+            # is never blocked on the TPU plugin.
+            result = attempt("--child-cpu", ATTEMPT_TIMEOUT_S)
     if result is None:
         # Even CPU failed (should not happen): still emit a valid JSON line.
         result = {
@@ -462,6 +587,17 @@ def main():
             "tpu_available": False,
             "detail": {"error": "all bench attempts failed; see stderr"},
         }
+    if _TIMEOUT_FLIGHTS:
+        # the timed-out children's last spans travel WITH the emitted
+        # metric: a CPU-fallback round now carries the evidence of where
+        # the accelerator attempt's 300s actually went
+        result.setdefault("detail", {})["timeout_flights"] = _TIMEOUT_FLIGHTS
+    if _TELEMETRY_DIR:
+        # always surfaced (not only on timeout): the dir holds the run's
+        # trace files — `python -m dmlc_core_tpu.telemetry trace <dir>`
+        # assembles the probe-run timeline — and naming it keeps a
+        # tempdir-backed run from silently accumulating unaccounted dirs
+        result.setdefault("detail", {})["telemetry_dir"] = _TELEMETRY_DIR
     print(json.dumps(result), flush=True)
 
 
@@ -482,7 +618,15 @@ if __name__ == "__main__":
         except SoftDeadline as e:
             # Clean, honest exit: the parent sees the tagged error JSON,
             # treats the attempt as failed, and no mid-RPC SIGKILL ever
-            # reaches the tunnel client.
+            # reaches the tunnel client.  The flight dump records the last
+            # spans before the watchdog fired (same artifact a hard
+            # timeout leaves, so both paths diagnose identically).
+            try:
+                from dmlc_core_tpu import telemetry
+
+                telemetry.flight.dump("soft_deadline")
+            except Exception:
+                pass
             log_stage(str(e))
             print(JSON_TAG + json.dumps({"error": str(e)}), flush=True)
     else:

@@ -34,6 +34,7 @@ CLI_EXEMPT = {
     "dmlc_core_tpu/io/__main__.py",
     "dmlc_core_tpu/analysis/driver.py",  # this CLI reports to stdout
     "dmlc_core_tpu/telemetry/report.py",  # `telemetry report` CLI table
+    "dmlc_core_tpu/telemetry/traceview.py",  # `telemetry trace` CLI report
     "dmlc_core_tpu/telemetry/__main__.py",
     "dmlc_core_tpu/fault/__main__.py",  # `fault validate` CLI report
     "dmlc_core_tpu/serve/__main__.py",  # `python -m dmlc_core_tpu.serve` CLI

@@ -66,6 +66,7 @@ import numpy as np
 from dmlc_core_tpu import fault, telemetry
 from dmlc_core_tpu.data.row_block import (COLUMN_ORDER, RowBlock,
                                           RowBlockContainer, align8)
+from dmlc_core_tpu.telemetry import tracecontext
 from dmlc_core_tpu.utils.logging import log_warning
 
 __all__ = ["ProcParsePool", "resolve_nproc", "attach_block", "engaged",
@@ -152,14 +153,24 @@ def _worker_parser(spec: Tuple[str, str, Dict[str, Any]]) -> Any:
     return parser
 
 
-def _worker_parse(spec: Tuple[str, str, Dict[str, Any]],
-                  data: bytes) -> Dict[str, Any]:
-    """Parse one newline-aligned range; columns go out via shared memory."""
+def _worker_parse(spec: Tuple[str, str, Dict[str, Any]], data: bytes,
+                  traceparent: Optional[str] = None) -> Dict[str, Any]:
+    """Parse one newline-aligned range; columns go out via shared memory.
+
+    ``traceparent`` is the consumer's trace context shipped alongside the
+    range (the same W3C string the serving path puts in HTTP headers): the
+    worker's parse span — recorded in ITS process, flushed in ITS span
+    file — joins the parent's trace, so the assembled timeline shows the
+    fan-out instead of orphaned worker activity.
+    """
     t0 = time.monotonic()
     parser = _worker_parser(spec)
-    if fault.enabled():
-        fault.inject("data.parse_worker", parser=type(parser).__name__)
-    container = parser.parse_block(data)
+    with tracecontext.activate(tracecontext.from_traceparent(traceparent)):
+        if fault.enabled():
+            fault.inject("data.parse_worker", parser=type(parser).__name__)
+        with telemetry.span("parse_worker.parse_block",
+                            parser=type(parser).__name__, nbytes=len(data)):
+            container = parser.parse_block(data)
     block = container.get_block()
     meta: Dict[str, Any] = {
         "rows": int(block.size),
@@ -379,7 +390,11 @@ class ProcParsePool:
         the error propagates — the workers unregister their segments from
         the resource tracker (the consumer owns cleanup), so a dropped meta
         would otherwise leak /dev/shm bytes until reboot."""
-        futures = [self._pool.submit(_worker_parse, self._spec, r)
+        # context propagation rides NEXT TO the payload, never inside it:
+        # the worker re-activates it around the parse span only
+        tp = (tracecontext.current_traceparent()
+              if telemetry.enabled() else None)
+        futures = [self._pool.submit(_worker_parse, self._spec, r, tp)
                    for r in ranges]
         metas: List[Dict[str, Any]] = []
         error: Optional[BaseException] = None

@@ -146,6 +146,13 @@ def fires() -> List[Tuple[str, str, int]]:
 def _note(site: str, kind: str) -> None:
     logger.warning("fault injected: site=%s kind=%s", site, kind)
     telemetry.count("dmlc_fault_injected_total", site=site, kind=kind)
+    # the fire lands ON the span that was running when it hit: an instant
+    # event carrying the thread's active trace context, so an assembled
+    # trace shows exactly which request/chunk ate the injected fault —
+    # and the flight ring keeps it even if the process dies right after
+    telemetry.event("fault.injected", site=site, kind=kind)
+    if not telemetry.enabled():
+        telemetry.flight.note("fault.injected", site=site, kind=kind)
 
 
 def inject(site: str, **ctx: Any) -> None:
@@ -169,8 +176,11 @@ def inject(site: str, **ctx: Any) -> None:
         raise ConnectionResetError(rule.message)
     if rule.kind == "exit":
         # flush the fault ledger to telemetry before dying, so a killed
-        # worker's chaos run still shows WHERE it was killed
+        # worker's chaos run still shows WHERE it was killed; the flight
+        # dump marks the process as crashed (reason names the site) so the
+        # trace assembler reports it instead of showing silence
         try:
+            telemetry.flight.dump(f"fault_exit:{site}")
             if telemetry.enabled():
                 telemetry._atexit_flush()
         except Exception:

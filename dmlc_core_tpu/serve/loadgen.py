@@ -22,6 +22,13 @@ Outcome taxonomy (the SLO vocabulary of docs/serving.md):
 
 The graceful-degradation proof is ``crashed == 0`` under an active fault
 plan: every request got *an* answer, even if that answer was "not now".
+
+Every request additionally carries a fresh W3C ``traceparent`` (the client
+is each trace's root), the per-request sample records its ``trace_id``,
+and the report lists the **top-5 slowest trace ids** — so the worst-p99
+offenders in an SLO report can be looked up directly in the merged trace
+(``python -m dmlc_core_tpu.telemetry trace <dir>``) instead of being
+anonymous latency numbers.
 """
 
 from __future__ import annotations
@@ -32,11 +39,15 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-from dmlc_core_tpu.telemetry import clock
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.telemetry import clock, tracecontext
 
 __all__ = ["run_load", "percentile", "LoadReport"]
+
+# how many worst-latency samples the report names by trace id
+SLOWEST_TRACES = 5
 
 OUTCOMES = ("ok", "shed", "timeout", "rejected", "error", "crashed")
 
@@ -62,33 +73,46 @@ def _gen_rows(rng: random.Random, n: int, num_feature: int) -> List[List[float]]
 
 
 class _Recorder:
-    """Thread-safe outcome/latency sink."""
+    """Thread-safe outcome/latency sink (one sample per request, with the
+    request's trace_id so any latency can be found in the merged trace)."""
 
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.counts = {k: 0 for k in OUTCOMES}
-        self.latencies_ok: List[float] = []
-        self.latencies_all: List[float] = []
         self.statuses: Dict[str, int] = {}
+        # (latency_s, trace_id, outcome, status) per request — the single
+        # store every latency view (quantiles, slowest table) derives from
+        self.samples: List[Tuple[float, str, str, Optional[int]]] = []
 
     def record(self, outcome: str, latency_s: float,
-               status: Optional[int]) -> None:
+               status: Optional[int], trace_id: str) -> None:
         with self.lock:
             self.counts[outcome] += 1
-            self.latencies_all.append(latency_s)
-            if outcome == "ok":
-                self.latencies_ok.append(latency_s)
             if status is not None:
                 key = str(status)
                 self.statuses[key] = self.statuses.get(key, 0) + 1
+            self.samples.append((latency_s, trace_id, outcome, status))
+
+    def latencies(self, outcome: Optional[str] = None) -> List[float]:
+        with self.lock:
+            return [s[0] for s in self.samples
+                    if outcome is None or s[2] == outcome]
+
+    def slowest(self, n: int) -> List[Dict[str, Any]]:
+        with self.lock:
+            worst = sorted(self.samples, key=lambda s: -s[0])[:n]
+        return [{"trace_id": t, "latency_ms": round(lat * 1e3, 3),
+                 "outcome": outcome, "status": status}
+                for lat, t, outcome, status in worst]
 
 
 def _issue(url: str, body: bytes, timeout_s: float,
-           expect_rows: int) -> tuple:
+           expect_rows: int, traceparent: str) -> tuple:
     """One POST; returns (outcome, status|None)."""
     req = urllib.request.Request(
         url + "/v1/score", data=body,
-        headers={"Content-Type": "application/json"}, method="POST")
+        headers={"Content-Type": "application/json",
+                 "traceparent": traceparent}, method="POST")
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             payload = json.load(resp)
@@ -149,8 +173,23 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
     start = clock.monotonic()
 
     def fire(scheduled_at: float, body: bytes) -> None:
-        outcome, status = _issue(url, body, timeout_s, rows_per_request)
-        rec.record(outcome, clock.monotonic() - start - scheduled_at, status)
+        # each request roots a fresh trace.  The header is attached even
+        # when THIS process collects nothing (the W3C propagation norm:
+        # the server side may be tracing — its spans then carry ids the
+        # report names); with local telemetry on, the client span is
+        # recorded under these exact ids so the server's serve.request
+        # parents to a span that really exists in the assembled trace.
+        trace_id = tracecontext.new_trace_id()
+        span_id = tracecontext.new_span_id()
+        tp = tracecontext.format_traceparent(
+            tracecontext.TraceContext(trace_id, span_id))
+        t0 = clock.monotonic()
+        outcome, status = _issue(url, body, timeout_s, rows_per_request, tp)
+        t1 = clock.monotonic()
+        telemetry.record_span("client.request", t0, t1,
+                              trace=(trace_id, span_id, None),
+                              outcome=outcome, status=status or 0)
+        rec.record(outcome, t1 - start - scheduled_at, status, trace_id)
 
     with ThreadPoolExecutor(max_workers=max_workers,
                             thread_name_prefix="loadgen") as pool:
@@ -162,8 +201,8 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         # pool __exit__ joins all in-flight requests
     wall = clock.monotonic() - start
 
-    lat_ok = sorted(rec.latencies_ok)
-    lat_all = sorted(rec.latencies_all)
+    lat_ok = sorted(rec.latencies("ok"))
+    lat_all = sorted(rec.latencies())
     n = len(arrivals)
     report: LoadReport = {
         "offered_qps": qps,
@@ -187,6 +226,9 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
             "p50": _ms(percentile(lat_all, 0.50)),
             "p99": _ms(percentile(lat_all, 0.99)),
         },
+        # the worst offenders BY NAME: feed these ids to
+        # `telemetry trace <dir>` to see where each one's time went
+        "slowest_traces": rec.slowest(SLOWEST_TRACES),
     }
     server_stats = _fetch_stats(url, timeout_s)
     if server_stats is not None:

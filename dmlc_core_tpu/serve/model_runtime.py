@@ -29,6 +29,7 @@ from typing import Any, Dict, Optional, Sequence
 import numpy as np
 
 from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.telemetry import tracecontext
 from dmlc_core_tpu.utils.logging import CHECK, log_info
 
 __all__ = ["ModelRuntime", "LinearRuntime", "MLPRuntime", "GBDTRuntime",
@@ -63,11 +64,22 @@ class ModelRuntime:
         scheduler can emit is compiled before the listener opens.
         """
         warmed = 0
-        for b in sorted(set(int(b) for b in batch_sizes)):
-            with telemetry.span("serve.warmup", model=self.name, batch=b):
-                self.predict(np.zeros((b, self.num_feature), np.float32))
-            telemetry.count("dmlc_serve_warmup_total", model=self.name)
-            warmed += 1
+        # all warmup compiles share one trace (a fresh root unless the
+        # process is already inside one, e.g. a DMLC_TRACEPARENT-rooted
+        # server launch): "model load" reads as a single story in the
+        # assembled timeline rather than N disconnected spans
+        ctx = (tracecontext.new_root()
+               if telemetry.enabled() and tracecontext.current() is None
+               else None)
+        with tracecontext.activate(ctx), \
+                telemetry.span("serve.warmup_all", model=self.name):
+            for b in sorted(set(int(b) for b in batch_sizes)):
+                with telemetry.span("serve.warmup", model=self.name,
+                                    batch=b):
+                    self.predict(np.zeros((b, self.num_feature),
+                                          np.float32))
+                telemetry.count("dmlc_serve_warmup_total", model=self.name)
+                warmed += 1
         log_info(f"serve: warmed {warmed} batch shape(s) for {self.name} "
                  f"({sorted(set(int(b) for b in batch_sizes))})")
         return warmed

@@ -48,7 +48,7 @@ from dmlc_core_tpu.bridge.batching import bucket_size
 from dmlc_core_tpu.serve.admission import AdmissionController
 from dmlc_core_tpu.serve.errors import BadRequest, Overloaded, PredictFailed
 from dmlc_core_tpu.serve.model_runtime import ModelRuntime
-from dmlc_core_tpu.telemetry import clock
+from dmlc_core_tpu.telemetry import clock, tracecontext
 from dmlc_core_tpu.utils.logging import log_error, log_warning
 
 __all__ = ["MicroBatcher", "batch_buckets"]
@@ -76,13 +76,18 @@ def batch_buckets(max_batch: int) -> List[int]:
 class _Pending:
     """One admitted request riding the queue toward a batch."""
 
-    __slots__ = ("rows", "future", "nbytes", "enqueued_at")
+    __slots__ = ("rows", "future", "nbytes", "enqueued_at", "ctx")
 
-    def __init__(self, rows: np.ndarray, future, nbytes: int, now: float):
+    def __init__(self, rows: np.ndarray, future, nbytes: int, now: float,
+                 ctx=None):
         self.rows = rows
         self.future = future
         self.nbytes = nbytes
         self.enqueued_at = now
+        # the submitting request's trace context (handler thread), so the
+        # batcher thread can credit queue wait + predict share back to the
+        # request's own trace even though it runs them on behalf of many
+        self.ctx = ctx
 
 
 class MicroBatcher:
@@ -182,7 +187,9 @@ class MicroBatcher:
         self.admission.try_admit(rows.nbytes)
         from concurrent.futures import Future
 
-        item = _Pending(rows, Future(), rows.nbytes, clock.monotonic())
+        ctx = tracecontext.current() if telemetry.enabled() else None
+        item = _Pending(rows, Future(), rows.nbytes, clock.monotonic(),
+                        ctx=ctx)
         with self._thread_lock:
             if self._stop.is_set():
                 self.admission.release(item.nbytes)
@@ -283,7 +290,16 @@ class MicroBatcher:
                               now - item.enqueued_at)
         try:
             with telemetry.span("serve.batch", rows=n, bucket=bucket,
-                                requests=len(batch)):
+                                requests=len(batch)) as batch_span:
+                if telemetry.enabled():
+                    # the batch belongs to no single request: it LINKS the
+                    # trace of every request it coalesced, so the assembler
+                    # (and a human in Perfetto) can hop batch -> requests
+                    linked = [item.ctx.trace_id for item in batch
+                              if item.ctx is not None]
+                    if linked:
+                        batch_span.set(links=",".join(linked[:32]),
+                                       linked_traces=len(linked))
                 x = np.zeros((bucket, self.runtime.num_feature), np.float32)
                 ofs = 0
                 for item in batch:
@@ -295,9 +311,29 @@ class MicroBatcher:
                 with telemetry.span("serve.predict",
                                     model=self.runtime.name, bucket=bucket):
                     y = self.runtime.predict(x)
-                telemetry.observe("dmlc_serve_predict_seconds",
-                                  clock.monotonic() - t0,
+                t1 = clock.monotonic()
+                telemetry.observe("dmlc_serve_predict_seconds", t1 - t0,
                                   model=self.runtime.name)
+                if telemetry.enabled():
+                    # per-request attribution INTO each request's own
+                    # trace: its queue wait and its share of the shared
+                    # predict call, parented under the request's
+                    # serve.request span — the two stages the critical-path
+                    # analysis splits a scored request into
+                    for item in batch:
+                        ctx = item.ctx
+                        if ctx is None or not ctx.span_id:
+                            continue
+                        telemetry.record_span(
+                            "serve.queue.wait", item.enqueued_at, now,
+                            trace=(ctx.trace_id, tracecontext.new_span_id(),
+                                   ctx.span_id))
+                        telemetry.record_span(
+                            "serve.predict", t0, t1,
+                            trace=(ctx.trace_id, tracecontext.new_span_id(),
+                                   ctx.span_id),
+                            bucket=bucket, rows=item.rows.shape[0],
+                            shared_requests=len(batch))
         except Exception as exc:
             telemetry.count("dmlc_serve_predict_errors_total",
                             model=self.runtime.name)

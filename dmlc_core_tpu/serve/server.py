@@ -40,7 +40,7 @@ from dmlc_core_tpu.serve.errors import (BadRequest, RequestTimeout,
                                         ServeError)
 from dmlc_core_tpu.serve.model_runtime import ModelRuntime
 from dmlc_core_tpu.serve.scheduler import MicroBatcher
-from dmlc_core_tpu.telemetry import clock
+from dmlc_core_tpu.telemetry import clock, tracecontext
 from dmlc_core_tpu.telemetry.report import (REPORT_QUANTILES, _label_str,
                                             estimate_quantiles)
 from dmlc_core_tpu.utils.logging import log_debug, log_info, log_warning
@@ -177,8 +177,15 @@ class _Handler(BaseHTTPRequestHandler):
         app = self.app
         t0 = clock.monotonic()
         status = 500
+        # continue the caller's W3C trace when one is announced: the
+        # serve.request span (and everything the handler does under it —
+        # batcher wait, predict share) joins the client's trace_id, which
+        # is what lets the offline assembler resolve a scored request to
+        # exactly one cross-process trace.  A malformed header decodes to
+        # None and the request simply runs untraced (W3C: ignore, never 500)
+        ctx = tracecontext.from_traceparent(self.headers.get("traceparent"))
         try:
-            with telemetry.span("serve.request"):
+            with tracecontext.activate(ctx), telemetry.span("serve.request"):
                 injected = fault.http_response("serve.request")
                 if injected is not None:
                     i_status, i_headers, i_body = injected

@@ -26,7 +26,14 @@ Enable explicitly via :func:`enable`, or by environment:
 - ``DMLC_TELEMETRY=1``     — enable collection;
 - ``DMLC_TELEMETRY_DIR=d`` — enable collection AND flush every export form
   into ``d`` at interpreter exit (rank/pid-keyed filenames, aggregatable
-  across ranks with ``python -m dmlc_core_tpu.telemetry report d``).
+  across ranks with ``python -m dmlc_core_tpu.telemetry report d``), and
+  arm the flight recorder's abnormal-exit dumps (:mod:`.flight`).
+
+Spans carry **distributed trace identity** when a trace context is active
+(:mod:`.tracecontext`: W3C ``traceparent`` over HTTP headers /
+``DMLC_TRACEPARENT`` env / explicit argument); assemble per-process span
+files + crash dumps into one merged Perfetto trace with per-trace critical
+paths via ``python -m dmlc_core_tpu.telemetry trace d``.
 
 Telemetry helpers are **host-side only**: calling them inside jit/pallas-
 traced code would bake one trace-time measurement into the compiled function
@@ -42,6 +49,8 @@ import threading
 from typing import Any, Dict, Iterable, Optional
 
 from dmlc_core_tpu.telemetry import clock  # noqa: F401  (re-export)
+from dmlc_core_tpu.telemetry import flight  # noqa: F401  (re-export)
+from dmlc_core_tpu.telemetry import tracecontext  # noqa: F401  (re-export)
 from dmlc_core_tpu.telemetry.registry import (DEFAULT_BUCKETS, Counter, Gauge,
                                               Histogram, MetricRegistry)
 from dmlc_core_tpu.telemetry.spans import Span, SpanTracer
@@ -49,10 +58,11 @@ from dmlc_core_tpu.telemetry.spans import Span, SpanTracer
 __all__ = [
     "enabled", "enable", "disable", "reset",
     "count", "gauge_set", "gauge_add", "observe", "span", "record_span",
+    "event",
     "get_registry", "get_tracer",
     "snapshot", "prometheus_text", "flush",
     "Counter", "Gauge", "Histogram", "MetricRegistry", "SpanTracer", "Span",
-    "DEFAULT_BUCKETS", "clock",
+    "DEFAULT_BUCKETS", "clock", "flight", "tracecontext",
 ]
 
 _enabled = False
@@ -88,7 +98,8 @@ def enabled() -> bool:
 
 
 def enable(flush_dir: Optional[str] = None) -> None:
-    """Turn collection on; with ``flush_dir``, also flush at interpreter exit."""
+    """Turn collection on; with ``flush_dir``, also flush at interpreter exit
+    and arm the flight recorder's abnormal-exit dumps into the same dir."""
     global _enabled, _flush_dir, _atexit_registered
     with _lock:
         _enabled = True
@@ -97,6 +108,7 @@ def enable(flush_dir: Optional[str] = None) -> None:
             if not _atexit_registered:
                 atexit.register(_atexit_flush)
                 _atexit_registered = True
+            flight.install(flush_dir)
 
 
 def disable() -> None:
@@ -155,11 +167,25 @@ def span(name: str, /, **attrs: Any):
     return _tracer.span(name, **attrs)
 
 
-def record_span(name: str, start: float, end: float, /, **attrs: Any) -> None:
-    """Record a span bracketed by two :func:`clock.monotonic` readings."""
+def record_span(name: str, start: float, end: float, /, *,
+                trace=None, **attrs: Any) -> None:
+    """Record a span bracketed by two :func:`clock.monotonic` readings.
+
+    ``trace`` optionally pins explicit ``(trace_id, span_id, parent_id)``
+    identity (cross-thread attribution); without it, the recording thread's
+    active trace context applies as usual."""
     if not _enabled:
         return
-    _tracer.record_complete(name, start, end, **attrs)
+    _tracer.record_complete(name, start, end, trace=trace, **attrs)
+
+
+def event(name: str, /, *, trace=None, **attrs: Any) -> None:
+    """Record an instant event on the current span/context (no-op when
+    disabled) — how point-in-time facts like fault-site fires land *on*
+    the span that was running when they happened."""
+    if not _enabled:
+        return
+    _tracer.record_instant(name, trace=trace, **attrs)
 
 
 # -- access / export ---------------------------------------------------------

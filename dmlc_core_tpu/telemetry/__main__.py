@@ -1,11 +1,11 @@
-"""CLI: ``python -m dmlc_core_tpu.telemetry report <dir> [--json]``."""
+"""CLI: ``python -m dmlc_core_tpu.telemetry {report,trace} <dir> [...]``."""
 
 from __future__ import annotations
 
 import argparse
 import sys
 
-from dmlc_core_tpu.telemetry import report
+from dmlc_core_tpu.telemetry import report, traceview
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -18,6 +18,20 @@ def build_parser() -> argparse.ArgumentParser:
     rep.add_argument("dir", help="directory holding metrics-*.json snapshots")
     rep.add_argument("--json", action="store_true",
                      help="emit the merged result as JSON instead of a table")
+    tr = sub.add_parser(
+        "trace", help="assemble per-process span files + flight dumps into "
+                      "one merged trace; critical path per trace_id")
+    tr.add_argument("dir", help="directory holding trace-*.trace.json / "
+                                "flight-*.json files")
+    tr.add_argument("--out", default=None,
+                    help="write the merged Perfetto trace JSON here")
+    tr.add_argument("--json", action="store_true",
+                    help="emit the assembly report as JSON")
+    tr.add_argument("--top", type=int, default=10,
+                    help="slowest-traces table length (default 10)")
+    tr.add_argument("--fail-on-orphans", action="store_true",
+                    help="exit 2 when any span's recorded parent is missing "
+                         "from the merged set (the CI propagation gate)")
     return parser
 
 
@@ -25,6 +39,10 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "report":
         return report.main(args.dir, as_json=args.json)
+    if args.cmd == "trace":
+        return traceview.main(args.dir, out=args.out, as_json=args.json,
+                              top=args.top,
+                              fail_on_orphans=args.fail_on_orphans)
     return 2
 
 

@@ -19,6 +19,7 @@ import os
 import time
 from typing import Any, Dict, Optional
 
+from dmlc_core_tpu.telemetry import clock
 from dmlc_core_tpu.telemetry.registry import Histogram, MetricRegistry
 from dmlc_core_tpu.telemetry.spans import SpanTracer
 
@@ -115,6 +116,7 @@ def json_snapshot(registry: MetricRegistry,
         "time": time.time(),
         "pid": os.getpid(),
         "rank": rank_from_env(),
+        "wall_epoch_s": clock.wall_epoch(),
         "metrics": families,
     }
     if tracer is not None:

@@ -49,7 +49,7 @@ from typing import Dict, List, Optional, Tuple
 
 from dmlc_core_tpu import fault, telemetry
 from dmlc_core_tpu.param import get_env
-from dmlc_core_tpu.telemetry import clock
+from dmlc_core_tpu.telemetry import clock, tracecontext
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
 
@@ -359,6 +359,14 @@ class RabitTracker:
         self.failed_ranks: Dict[int, str] = {}
         # tracker-fatal condition (rendezvous deadline); join() raises it
         self.error: Optional[str] = None
+        # the rendezvous trace: the accept loop runs under this context
+        # (its connect/assign/barrier spans parent to the root span below),
+        # and worker_envs() exports it as DMLC_TRACKER_TRACEPARENT so every
+        # launched worker's spans join the same trace from its side of the
+        # wire — one assembled timeline for the whole cold start
+        self.trace = tracecontext.TraceContext(tracecontext.new_trace_id(),
+                                               tracecontext.new_span_id())
+        self._constructed_at = clock.monotonic()
         logger.info("start listening on %s:%d", host_ip, self.port)
 
     # -- topology (tracker.py:165-252) ---------------------------------------
@@ -424,7 +432,9 @@ class RabitTracker:
     # -- env contract ---------------------------------------------------------
     def worker_envs(self) -> Dict[str, str]:
         return {"DMLC_TRACKER_URI": self.host_ip,
-                "DMLC_TRACKER_PORT": str(self.port)}
+                "DMLC_TRACKER_PORT": str(self.port),
+                tracecontext.TRACKER_TRACEPARENT_ENV:
+                    tracecontext.format_traceparent(self.trace)}
 
     # -- accept loop (tracker.py:254-320) -------------------------------------
     def _reject(self, sock: socket.socket, reason: str, detail) -> None:
@@ -464,7 +474,11 @@ class RabitTracker:
 
     def _accept_workers(self, n: int) -> None:
         try:
-            self._accept_workers_inner(n)
+            # the loop thread runs under the rendezvous trace context, so
+            # every span recorded inside (connect/assign/barrier) parents
+            # to the tracker.rendezvous root span recorded below
+            with tracecontext.activate(self.trace):
+                self._accept_workers_inner(n)
         except Exception as exc:  # noqa: BLE001 — ferried to join()
             # the accept loop is the whole control plane: a crash here must
             # surface as a structured tracker error, never a silently dead
@@ -473,6 +487,17 @@ class RabitTracker:
             self.error = (f"tracker accept loop died: "
                           f"{type(exc).__name__}: {exc}")
         finally:
+            # recorded on EVERY exit path — clean finish, deadline expiry,
+            # loop crash — as a child of the root span start() already
+            # flushed (the loop may block forever when workers coordinate
+            # via jax.distributed and never dial back; the root must not
+            # depend on it exiting)
+            telemetry.record_span(
+                "tracker.rendezvous", self._constructed_at, clock.monotonic(),
+                trace=(self.trace.trace_id, tracecontext.new_span_id(),
+                       self.trace.span_id),
+                world=n, error=self.error or "",
+                failed_ranks=len(self.failed_ranks))
             # clean shutdown on every exit path: the port is freed and no
             # late client can block on a listener nobody serves
             try:
@@ -648,6 +673,16 @@ class RabitTracker:
 
     def start(self, num_workers: Optional[int] = None) -> None:
         n = num_workers if num_workers is not None else self.num_workers
+        # the trace's root span is recorded HERE, not at loop exit: workers
+        # that coordinate via the env contract + jax.distributed never dial
+        # the rabit sockets, the accept loop then blocks until process
+        # exit, and a root recorded only on loop exit would leave every
+        # worker-side span (parented to it via DMLC_TRACKER_TRACEPARENT)
+        # an orphan in the assembled trace
+        telemetry.record_span(
+            "tracker.start", self._constructed_at, clock.monotonic(),
+            trace=(self.trace.trace_id, self.trace.span_id, None),
+            world=n, host=self.host_ip, port=self.port)
         self.thread = threading.Thread(target=self._accept_workers, args=(n,),
                                        daemon=True)
         self.thread.start()

@@ -117,6 +117,43 @@ def test_roofline_absent_off_tpu(bench_run):
     assert "roofline" not in result.get("detail", {})
 
 
+def test_timed_out_child_flight_dump_reaches_bench_json(tmp_path):
+    """ISSUE 8 satellite: a child that exceeds its hard wall-clock budget
+    is SIGTERMed — and its flight-recorder dump (last recorded spans) is
+    collected into the emitted JSON's ``detail.timeout_flights`` instead
+    of being discarded with the child, so a CPU-fallback round carries the
+    evidence of where the accelerator attempt's budget went."""
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_ROWS": "2000",
+        # the probe (import jax + touch a CPU device) passes comfortably;
+        # the bench child cannot finish inside 8s, so it hard-times-out
+        "BENCH_PROBE_TIMEOUT_S": "120",
+        "BENCH_ATTEMPT_TIMEOUT_S": "8",
+        "BENCH_STAGE_DIR": str(tmp_path),
+    })
+    env.pop("XLA_FLAGS", None)
+    env.pop("BENCH_CHILD_DEADLINE_S", None)
+    proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    [line] = [l for l in proc.stdout.splitlines() if l.strip()]
+    result = json.loads(line)
+    assert result["platform"] == "none"       # every attempt timed out
+    flights = result["detail"]["timeout_flights"]
+    assert flights and flights[0]["mode"] == "--child-cpu"
+    # the SIGTERMed child left at least one dump behind (handler-chained
+    # or the interval writer); its contents are the child's last spans
+    assert flights[0]["flight"], proc.stderr[-2000:]
+    assert all("reason" in d and "last_events" in d
+               for d in flights[0]["flight"])
+    # the same dumps are persisted stage-side for the wedge-proof trail
+    stage = json.loads(
+        (tmp_path / "attempt__child_cpu_rows2000.json").read_text())
+    assert "flight" in stage
+
+
 def test_detail_carries_telemetry_snapshot(bench_run):
     """ISSUE 2 satellite: each emitted metric's detail carries the telemetry
     registry snapshot, so BENCH rounds have per-stage attribution (parser

@@ -1,0 +1,241 @@
+"""End-to-end distributed-tracing tests (ISSUE 8 acceptance):
+
+- one trace_id survives client -> HTTP server (separate OS process) ->
+  micro-batcher -> predict AND parent -> parse_proc pool workers (two more
+  OS processes), assembling into a single trace with no orphan spans;
+- the loadgen SLO report names its worst offenders by trace id;
+- (chaos) an injected fault fire lands as an instant event ON the
+  enclosing span, and a chaos-killed parse worker leaves a flight-recorder
+  dump that the trace assembler reports as a crashed process.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.data import parse_proc
+from dmlc_core_tpu.telemetry import flight, tracecontext as tc, traceview
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_LIBSVM_SPEC = ("dmlc_core_tpu.data.libsvm_parser", "LibSVMParser",
+                {"nthread": 1, "index_dtype": "<u4"})
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    was_enabled = telemetry.enabled()
+    prior_root = tc.get_process_root()
+    telemetry.disable()
+    telemetry.reset()
+    flight.reset()
+    tc.set_process_root(None)
+    yield
+    fault.clear()
+    telemetry.disable()
+    telemetry.reset()
+    flight.reset()
+    tc.set_process_root(prior_root)
+    if was_enabled:
+        telemetry.enable()
+
+
+def _spawn_server(telemetry_dir, num_feature):
+    env = dict(os.environ,
+               DMLC_TELEMETRY_DIR=str(telemetry_dir),
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dmlc_core_tpu.serve", "--model", "linear",
+         "--num-feature", str(num_feature), "--port", "0", "--no-warmup"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    url = None
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if "serving linear on http://" in line:
+            url = line.split("on ", 1)[1].split()[0]
+            break
+    return proc, url
+
+
+def _stop_server(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    if proc.stdout is not None:
+        proc.stdout.close()
+
+
+def _assemble_until(telemetry_dir, predicate, timeout_s=30.0):
+    """Assemble repeatedly until ``predicate(asm)`` holds (pool workers and
+    the server flush their span files asynchronously at process exit)."""
+    deadline = time.monotonic() + timeout_s
+    asm = traceview.assemble(str(telemetry_dir))
+    while not predicate(asm) and time.monotonic() < deadline:
+        time.sleep(0.5)
+        asm = traceview.assemble(str(telemetry_dir))
+    return asm
+
+
+def test_trace_propagation_three_processes(tmp_path, monkeypatch):
+    """The acceptance walk: one trace spanning the test process (client +
+    parse consumer), the scoring server process, and parse pool worker
+    processes — >=3 OS pids in one assembled trace, zero orphans."""
+    tel = tmp_path / "tel"
+    tel.mkdir()
+    # pool workers inherit this env and flush their own span files into it
+    monkeypatch.setenv("DMLC_TELEMETRY_DIR", str(tel))
+    parse_proc.shutdown()          # fresh pool under the new env
+    num_feature = 4
+    server, url = _spawn_server(tel, num_feature)
+    try:
+        assert url, "server did not come up"
+        telemetry.enable()
+        with tc.activate(tc.new_root()):
+            with telemetry.span("e2e.root") as root:
+                trace_id = root.trace_id
+                # leg 1: HTTP with the ambient context as traceparent
+                body = json.dumps(
+                    {"instances": [[0.1, 0.2, 0.3, 0.4]]}).encode()
+                req = urllib.request.Request(
+                    url + "/v1/score", data=body, method="POST",
+                    headers={"Content-Type": "application/json",
+                             "traceparent": tc.current_traceparent()})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = json.load(resp)
+                assert len(payload["predictions"]) == 1
+                # leg 2: parse fan-out to pool worker processes
+                pool = parse_proc.ProcParsePool(_LIBSVM_SPEC, 2)
+                blocks = pool.parse_ranges([b"1 0:1.5\n0 2:2.0\n" * 200,
+                                            b"1 1:0.5\n" * 150])
+                assert sum(b.size for b in blocks) == 550
+                pool.close()
+    finally:
+        _stop_server(server)
+    parse_proc.shutdown()          # workers exit -> atexit flush
+    telemetry.flush(str(tel))
+
+    def ready(asm):
+        ours = [t for t in asm["traces"] if t["trace_id"] == trace_id]
+        return ours and len(ours[0]["pids"]) >= 3 and ours[0]["orphans"] == 0
+
+    asm = _assemble_until(tel, ready)
+    ours = [t for t in asm["traces"] if t["trace_id"] == trace_id]
+    assert len(ours) == 1, "the request must resolve to exactly one trace"
+    trace = ours[0]
+    assert len(trace["pids"]) >= 3, \
+        f"expected >=3 processes in the trace, got pids={trace['pids']}"
+    assert trace["orphans"] == 0, trace
+    stages = {p["stage"] for p in trace["critical_path"]}
+    # client -> HTTP -> batcher -> predict, and parent -> parse worker
+    assert {"e2e.root", "serve.request", "serve.predict",
+            "serve.queue.wait", "parse_worker.parse_block"} <= stages, stages
+    assert trace["total_ms"] > 0
+    # the critical path is computed and normalized
+    assert sum(p["share"] for p in trace["critical_path"]) \
+        == pytest.approx(1.0, abs=0.01)
+
+
+def test_loadgen_report_names_slowest_traces(tmp_path):
+    """Satellite: every loadgen sample records its trace_id and the report
+    prints the top-5 slowest — joinable against the assembled trace."""
+    from dmlc_core_tpu.serve.loadgen import run_load
+    from dmlc_core_tpu.serve.model_runtime import build_runtime
+    from dmlc_core_tpu.serve.server import ScoringServer
+
+    telemetry.enable()
+    runtime = build_runtime("linear", 6)
+    server = ScoringServer(runtime, max_batch=8, max_delay_ms=1.0).start()
+    try:
+        report = run_load(server.url, qps=40, duration_s=1.0, num_feature=6,
+                          rows_per_request=1, seed=5, timeout_s=10.0)
+    finally:
+        server.close()
+    assert report["counts"]["ok"] > 0
+    slowest = report["slowest_traces"]
+    assert 0 < len(slowest) <= 5
+    assert slowest == sorted(slowest, key=lambda s: -s["latency_ms"])
+    for entry in slowest:
+        assert len(entry["trace_id"]) == 32
+        assert entry["outcome"] in ("ok", "shed", "timeout", "rejected",
+                                    "error", "crashed")
+    # the named ids are real: each resolves in the recorded spans
+    telemetry.flush(str(tmp_path))
+    asm = traceview.assemble(str(tmp_path))
+    assembled = {t["trace_id"] for t in asm["traces"]}
+    assert {s["trace_id"] for s in slowest} <= assembled
+
+
+# -- chaos --------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_fault_fire_is_event_on_enclosing_span():
+    telemetry.enable()
+    fault.configure({"rules": [{"site": "tracker.accept", "kind": "delay",
+                                "seconds": 0.0}]})
+    try:
+        with tc.activate(tc.new_root()):
+            with telemetry.span("guarded.op"):
+                fault.inject("tracker.accept", host="t")
+    finally:
+        fault.clear()
+    events = telemetry.get_tracer().events()
+    fire = [e for e in events if e["name"] == "fault.injected"][0]
+    span = [e for e in events if e["name"] == "guarded.op"][0]
+    assert fire["ph"] == "i"
+    assert fire["trace_id"] == span["trace_id"]
+    assert fire["parent_id"] == span["span_id"]
+    assert fire["args"] == {"site": "tracker.accept", "kind": "delay"}
+    # the ring saw it too: this is what a post-mortem dump would carry
+    assert any(e.get("name") == "fault.injected" for e in flight.snapshot())
+
+
+_KILL_PLAN = ('{"rules": [{"site": "data.parse_worker", "kind": "exit", '
+              '"times": null}]}')
+
+
+@pytest.mark.chaos
+def test_killed_worker_leaves_flight_dump(tmp_path, monkeypatch):
+    """A chaos-killed worker (fault 'exit' -> os._exit) writes its flight
+    dump on the way down, and the assembler reports the process as
+    crashed — the killed side of the story is evidence, not silence."""
+    tel = tmp_path / "tel"
+    tel.mkdir()
+    monkeypatch.setenv("DMLC_TELEMETRY_DIR", str(tel))
+    monkeypatch.setenv("DMLC_FAULT_PLAN", _KILL_PLAN)
+    parse_proc.shutdown()          # workers read env at start
+    pool = parse_proc.ProcParsePool(_LIBSVM_SPEC, 2)
+    with pytest.raises(RuntimeError, match="parse worker died"):
+        pool.parse_ranges([b"1 0:1.0\n" * 500, b"0 1:2.0\n" * 500])
+    parse_proc.shutdown()
+    deadline = time.monotonic() + 20
+    dumps = []
+    while not dumps and time.monotonic() < deadline:
+        dumps = [p for p in os.listdir(tel) if p.startswith("flight-")]
+        time.sleep(0.2)
+    assert dumps, "killed worker left no flight dump"
+    with open(tel / dumps[0]) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "fault_exit:data.parse_worker"
+    assert any(e.get("name") == "fault.injected"
+               for e in payload["entries"])
+    # and the merged view names the crash instead of omitting the process
+    asm = traceview.assemble(str(tel))
+    assert any(c["reason"] == "fault_exit:data.parse_worker"
+               for c in asm["flights"])

@@ -1,0 +1,409 @@
+"""Distributed-tracing unit tests: W3C traceparent encode/decode, ambient
+context nesting + env/explicit propagation, the flight recorder's ring and
+dump discipline, the spans-dropped counter, and the ``telemetry trace``
+assembler (alignment, dedup, orphan detection, critical path, CLI exit
+codes)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.telemetry import flight, tracecontext as tc, traceview
+from dmlc_core_tpu.telemetry.spans import SpanTracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    """Tests start with no ambient context, empty tracer/ring; prior
+    enabled state is restored afterwards (same discipline as
+    test_telemetry's fixture — CI relies on collection staying on)."""
+    was_enabled = telemetry.enabled()
+    prior_root = tc.get_process_root()
+    telemetry.disable()
+    telemetry.reset()
+    flight.reset()
+    tc.set_process_root(None)
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    flight.reset()
+    tc.set_process_root(prior_root)
+    if was_enabled:
+        telemetry.enable()
+
+
+# -- traceparent wire format --------------------------------------------------
+
+def test_traceparent_roundtrip():
+    ctx = tc.TraceContext(tc.new_trace_id(), tc.new_span_id())
+    header = tc.format_traceparent(ctx)
+    version, trace_id, span_id, flags = header.split("-")
+    assert (version, flags) == ("00", "01")
+    assert len(trace_id) == 32 and len(span_id) == 16
+    back = tc.from_traceparent(header)
+    assert back == ctx
+
+
+def test_traceparent_requires_span_id():
+    with pytest.raises(ValueError):
+        tc.format_traceparent(tc.TraceContext(tc.new_trace_id(), None))
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-abc-def-01",
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",      # non-hex trace id
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",      # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",      # all-zero span id
+    "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",      # version ff is invalid
+    "00-" + "1" * 31 + "-" + "2" * 16 + "-01",      # short trace id
+    # version 00 defines exactly four fields; extras are invalid (only
+    # future versions may extend the format)
+    "00-" + "1" * 32 + "-" + "2" * 16 + "-01-extra",
+])
+def test_traceparent_malformed_rejected(bad):
+    assert tc.from_traceparent(bad) is None
+
+
+def test_traceparent_future_version_accepted():
+    header = "01-" + "a" * 32 + "-" + "b" * 16 + "-00-extrafield"
+    ctx = tc.from_traceparent(header)
+    assert ctx is not None and ctx.trace_id == "a" * 32
+
+
+# -- ambient context + span nesting ------------------------------------------
+
+def test_span_nesting_parents_automatically():
+    telemetry.enable()
+    with tc.activate(tc.new_root()):
+        with telemetry.span("outer") as outer:
+            with telemetry.span("inner"):
+                telemetry.event("mark", k="v")
+    events = {e["name"]: e for e in telemetry.get_tracer().events()}
+    assert events["outer"]["trace_id"] == events["inner"]["trace_id"]
+    assert "parent_id" not in events["outer"]          # root span
+    assert events["inner"]["parent_id"] == events["outer"]["span_id"]
+    assert events["mark"]["ph"] == "i"
+    assert events["mark"]["parent_id"] == events["inner"]["span_id"]
+    assert outer.trace_id == events["outer"]["trace_id"]
+
+
+def test_no_context_records_untraced():
+    telemetry.enable()
+    with telemetry.span("plain"):
+        pass
+    (event,) = telemetry.get_tracer().events()
+    assert "trace_id" not in event and "span_id" not in event
+
+
+def test_activation_is_thread_local():
+    telemetry.enable()
+    seen = {}
+
+    def other_thread():
+        with telemetry.span("elsewhere"):
+            pass
+        seen["ctx"] = tc.current()
+
+    with tc.activate(tc.new_root()):
+        t = threading.Thread(target=other_thread)
+        t.start()
+        t.join()
+    assert seen["ctx"] is None
+    elsewhere = [e for e in telemetry.get_tracer().events()
+                 if e["name"] == "elsewhere"][0]
+    assert "trace_id" not in elsewhere
+
+
+def test_process_root_applies_to_all_threads():
+    telemetry.enable()
+    root = tc.TraceContext(tc.new_trace_id(), tc.new_span_id())
+    tc.set_process_root(root)
+
+    def worker():
+        with telemetry.span("on.thread"):
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    ev = [e for e in telemetry.get_tracer().events()
+          if e["name"] == "on.thread"][0]
+    assert ev["trace_id"] == root.trace_id
+    assert ev["parent_id"] == root.span_id
+
+
+def test_explicit_trace_pins_identity():
+    telemetry.enable()
+    trace = (tc.new_trace_id(), tc.new_span_id(), None)
+    telemetry.record_span("pinned", 0.0, 0.1, trace=trace, attr="x")
+    (ev,) = telemetry.get_tracer().events()
+    assert ev["trace_id"] == trace[0] and ev["span_id"] == trace[1]
+    assert "parent_id" not in ev
+
+
+def test_child_env_and_env_bringup(monkeypatch):
+    with tc.activate(tc.TraceContext("ab" * 16, "cd" * 8)):
+        env = tc.child_env({"OTHER": "1"})
+    assert env["OTHER"] == "1"
+    assert env[tc.TRACEPARENT_ENV] == "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    monkeypatch.setenv(tc.TRACEPARENT_ENV, env[tc.TRACEPARENT_ENV])
+    tc._init_from_env()
+    root = tc.get_process_root()
+    assert root is not None and root.trace_id == "ab" * 16
+    # tracker env is the fallback when DMLC_TRACEPARENT is absent
+    monkeypatch.delenv(tc.TRACEPARENT_ENV)
+    monkeypatch.setenv(tc.TRACKER_TRACEPARENT_ENV,
+                       "00-" + "ef" * 16 + "-" + "ab" * 8 + "-01")
+    tc._init_from_env()
+    assert tc.get_process_root().trace_id == "ef" * 16
+
+
+def test_disabled_mode_is_noop():
+    with tc.activate(tc.new_root()):
+        span = telemetry.span("nope")
+        with span:
+            pass
+        telemetry.event("nope.event")
+    assert telemetry.get_tracer().events() == []
+    assert not isinstance(span, telemetry.Span)  # the shared null span
+
+
+# -- spans dropped: counted, exported, warned about ---------------------------
+
+def test_span_buffer_overflow_counts_dropped_metric():
+    telemetry.enable()
+    tracer = SpanTracer(max_events=2)
+    for i in range(5):
+        tracer.record(f"s{i}", 0.0, 1.0)
+    assert tracer.dropped == 3
+    assert telemetry.get_registry().counter(
+        "dmlc_telemetry_spans_dropped_total").value == 3
+
+
+def test_flight_ring_keeps_tail_past_overflow():
+    flight.reset()
+    tracer = SpanTracer(max_events=1)
+    for i in range(4):
+        tracer.record(f"s{i}", float(i), 1.0)
+    names = [e["name"] for e in flight.snapshot()]
+    # the buffer kept only s0; the ring saw every record including drops
+    assert names[-4:] == ["s0", "s1", "s2", "s3"]
+    assert len(tracer.events()) == 1
+
+
+def test_trace_cli_warns_on_drops(tmp_path, capsys):
+    telemetry.enable()
+    tracer = telemetry.get_tracer()
+    tracer.record("kept", 0.0, 5.0)
+    tracer.dropped = 7  # what a buffer overflow leaves behind
+    telemetry.flush(str(tmp_path))
+    rc = traceview.main(str(tmp_path))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "dropped 7 span(s)" in out
+    assert "may be incomplete" in out
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_flight_dump_roundtrip(tmp_path):
+    telemetry.enable()
+    with tc.activate(tc.new_root()):
+        with telemetry.span("doomed.op", step=3):
+            pass
+    path = flight.dump("test:boom", str(tmp_path))
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "test:boom"
+    assert payload["pid"] == os.getpid()
+    assert isinstance(payload["wall_epoch_s"], float)
+    names = [e["name"] for e in payload["entries"]]
+    assert "doomed.op" in names
+
+
+def test_flight_dump_without_dir_is_none(monkeypatch):
+    monkeypatch.delenv("DMLC_TELEMETRY_DIR", raising=False)
+    monkeypatch.setattr(flight, "_dump_dir", None)
+    assert flight.dump("nowhere") is None
+
+
+def test_flight_ring_is_bounded():
+    flight.reset()
+    cap = flight._ring.maxlen
+    for i in range(cap + 50):
+        flight.note("overflow.mark", i=i)
+    entries = flight.snapshot()
+    assert len(entries) == cap
+    assert entries[-1]["args"]["i"] == cap + 49
+
+
+def test_flight_dumps_on_sigterm_subprocess(tmp_path):
+    """A SIGTERMed process leaves its last spans behind (the bench-child
+    timeout contract): handler installed by enable(dir), chained dump."""
+    script = tmp_path / "victim.py"
+    script.write_text(
+        "import os, signal, sys, time\n"
+        "from dmlc_core_tpu import telemetry\n"
+        "from dmlc_core_tpu.telemetry import tracecontext as tc\n"
+        "with tc.activate(tc.new_root()):\n"
+        "    with telemetry.span('victim.work', phase='pre-hang'):\n"
+        "        pass\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(60)\n")
+    env = dict(os.environ, DMLC_TELEMETRY_DIR=str(tmp_path),
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+    finally:
+        proc.kill()
+    dumps = [p for p in os.listdir(tmp_path) if p.startswith("flight-")]
+    assert dumps, "SIGTERM left no flight dump"
+    with open(tmp_path / dumps[0]) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "sigterm"
+    assert "victim.work" in [e["name"] for e in payload["entries"]]
+
+
+def test_flight_dumps_on_unhandled_exception_subprocess(tmp_path):
+    script = tmp_path / "crasher.py"
+    script.write_text(
+        "from dmlc_core_tpu import telemetry\n"
+        "with telemetry.span('crasher.work'):\n"
+        "    pass\n"
+        "raise RuntimeError('boom')\n")
+    env = dict(os.environ, DMLC_TELEMETRY_DIR=str(tmp_path),
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "RuntimeError: boom" in proc.stderr  # the chained default hook ran
+    dumps = [p for p in os.listdir(tmp_path) if p.startswith("flight-")]
+    assert dumps
+    with open(tmp_path / dumps[0]) as f:
+        payload = json.load(f)
+    assert payload["reason"].startswith("unhandled_exception:RuntimeError")
+
+
+# -- the trace assembler ------------------------------------------------------
+
+def _fake_trace_file(tmp_path, pid, wall_epoch, events, tag=None):
+    payload = {"traceEvents": [
+        {"name": "clock_sync", "ph": "M", "pid": pid, "tid": 0,
+         "args": {"wall_epoch_s": wall_epoch}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": 1,
+         "args": {"name": "main"}},
+    ] + events, "displayTimeUnit": "ms"}
+    path = tmp_path / f"trace-r0-p{tag or pid}.trace.json"
+    path.write_text(json.dumps(payload))
+    return path
+
+
+def _span(name, pid, ts, dur, trace_id=None, span_id=None, parent_id=None):
+    ev = {"name": name, "ph": "X", "ts": ts, "dur": dur, "pid": pid,
+          "tid": 1}
+    if trace_id:
+        ev["trace_id"], ev["span_id"] = trace_id, span_id
+        if parent_id:
+            ev["parent_id"] = parent_id
+    return ev
+
+
+def test_assemble_aligns_and_joins_across_processes(tmp_path):
+    t = "f" * 32
+    # process A booted 10 wall-seconds before process B: A's monotonic ts
+    # run from 0, B's too — only the wall anchors can line them up
+    _fake_trace_file(tmp_path, 100, 1000.0, [
+        _span("client.request", 100, 0.0, 50_000.0, t, "a" * 16)])
+    _fake_trace_file(tmp_path, 200, 1010.0, [
+        _span("serve.request", 200, 5_000.0, 20_000.0, t, "b" * 16,
+              "a" * 16)])
+    asm = traceview.assemble(str(tmp_path))
+    assert asm["orphans"] == 0
+    (trace,) = asm["traces"]
+    assert trace["trace_id"] == t
+    assert trace["pids"] == [100, 200]
+    by_name = {e["name"]: e for e in asm["events"]}
+    # B's ts 5000us shifts by the 10s epoch gap onto A's axis
+    assert by_name["serve.request"]["ts"] == pytest.approx(10_005_000.0)
+    assert by_name["client.request"]["ts"] == pytest.approx(0.0)
+
+
+def test_assemble_flags_orphans_and_cli_gate(tmp_path, capsys):
+    t = "e" * 32
+    _fake_trace_file(tmp_path, 300, 1000.0, [
+        _span("serve.request", 300, 0.0, 1000.0, t, "b" * 16,
+              parent_id="dead" * 4)])
+    asm = traceview.assemble(str(tmp_path))
+    assert asm["orphans"] == 1
+    assert traceview.main(str(tmp_path)) == 0
+    assert traceview.main(str(tmp_path), fail_on_orphans=True) == 2
+    out = capsys.readouterr().out
+    assert "orphan" in out
+
+
+def test_assemble_dedups_flight_overlap(tmp_path):
+    t = "d" * 32
+    ev = _span("the.op", 400, 100.0, 5.0, t, "ab" * 8)
+    _fake_trace_file(tmp_path, 400, 1000.0, [ev])
+    (tmp_path / "flight-r0-p400.json").write_text(json.dumps({
+        "reason": "sigterm", "pid": 400, "rank": 0, "wall_epoch_s": 1000.0,
+        "entries": [ev,
+                    _span("only.in.flight", 400, 200.0, 5.0, t, "cd" * 8)]}))
+    asm = traceview.assemble(str(tmp_path))
+    names = [e["name"] for e in asm["events"]]
+    assert names.count("the.op") == 1          # deduplicated
+    assert "only.in.flight" in names           # recovered from the ring
+    (crash,) = asm["flights"]
+    assert crash["reason"] == "sigterm"
+    assert crash["events_recovered"] == 1
+
+
+def test_critical_path_charges_exclusive_time():
+    t = "c" * 32
+    spans = [
+        _span("request", 1, 0.0, 100_000.0, t, "a" * 16),
+        _span("queue.wait", 1, 1_000.0, 20_000.0, t, "b" * 16, "a" * 16),
+        _span("predict", 1, 21_000.0, 70_000.0, t, "ce" * 8, "a" * 16),
+    ]
+    path = traceview.critical_path(spans)
+    shares = {p["stage"]: p for p in path}
+    assert path[0]["stage"] == "predict"
+    assert shares["predict"]["exclusive_ms"] == pytest.approx(70.0)
+    # the parent is charged only its own 10ms, not the children's 90
+    assert shares["request"]["exclusive_ms"] == pytest.approx(10.0)
+    assert shares["queue.wait"]["exclusive_ms"] == pytest.approx(20.0)
+    assert sum(p["share"] for p in path) == pytest.approx(1.0)
+
+
+def test_trace_cli_writes_merged_perfetto(tmp_path, capsys):
+    t = "b" * 32
+    _fake_trace_file(tmp_path, 500, 1000.0, [
+        _span("solo.op", 500, 0.0, 10.0, t, "ab" * 8)])
+    out_path = tmp_path / "merged.trace.json"
+    rc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.telemetry", "trace",
+         str(tmp_path), "--out", str(out_path), "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert rc.returncode == 0, rc.stderr
+    report = json.loads(rc.stdout)
+    assert report["traces"][0]["trace_id"] == t
+    merged = json.loads(out_path.read_text())
+    names = {e.get("name") for e in merged["traceEvents"]}
+    assert "solo.op" in names and "thread_name" in names
+
+
+def test_trace_cli_empty_dir_exits_1(tmp_path, capsys):
+    assert traceview.main(str(tmp_path)) == 1
