@@ -5,8 +5,12 @@ metric "HIGGS rows/sec/chip (XGBoost hist)").
 Workload: HIGGS-shaped synthetic data (28 dense features), quantile-binned to
 256 bins, boosted depth-6 trees — the XGBoost hist configuration of the
 north star.  The full stack is exercised (libsvm text -> parser -> RowBlock ->
-dense batch -> device binning -> jit'd boosting rounds); the timed region is
-training, matching how XGBoost reports hist rows/sec.
+dense batch -> HOST binning to uint8 (bridge/binning.py) -> staged-once
+device feed -> jit'd boosting rounds); the timed region is training,
+matching how XGBoost reports hist rows/sec.  The wire carries the binned
+uint8 ids once (~1/12 the old float path's host<->device bytes); the
+emitted JSON's detail records `transfer_bytes` / `feed_rows_per_sec`
+next to the train figure so a transfer-bound round is attributable.
 
 vs_baseline = accelerator rows/sec / single-host-CPU rows/sec on the same
 training workload shape, each device running its best hist formulation
@@ -181,15 +185,28 @@ class SoftDeadline(Exception):
     gone: the child then exits CLEANLY (honest error JSON, rc 0) instead
     of being SIGKILLed mid-device-op by the parent — hard kills of a
     client mid-computation are what wedge the axon tunnel (observed r3
-    and again r5, BASELINE.md)."""
+    and again r5, BASELINE.md).  ``stage`` names the budgeted stage the
+    overage happened inside (e.g. "staging") when one was declared — the
+    flight dump then carries that name and the generic handler must not
+    clobber it."""
+
+    def __init__(self, msg, stage=None):
+        super().__init__(msg)
+        self.stage = stage
 
 
-def check_deadline(where):
+def check_deadline(where, stage=None):
     limit = float(os.environ.get("BENCH_CHILD_DEADLINE_S", 0) or 0)
     if limit and time.perf_counter() - _T0 > limit:
+        # ``stage`` tags the exception so the FATAL-exit handler can name
+        # the budgeted stage (soft_deadline_staging) in the flight dump.
+        # The dump is NOT written here: a recovered overage (the capped
+        # CPU-baseline phase catches SoftDeadline and still emits a valid
+        # result) must not leave fabricated wedge evidence beside a
+        # successful measurement.
         raise SoftDeadline(
             f"soft deadline {limit:.0f}s exceeded at '{where}' "
-            f"(+{time.perf_counter() - _T0:.1f}s)")
+            f"(+{time.perf_counter() - _T0:.1f}s)", stage=stage)
 
 
 def chunked_device_put(arr, device, n_chunks=16):
@@ -206,51 +223,75 @@ def chunked_device_put(arr, device, n_chunks=16):
     for i in range(n_chunks):
         parts.append(jax.device_put(arr[bounds[i]:bounds[i + 1]], device))
         jax.block_until_ready(parts[-1])
-        check_deadline(f"transfer chunk {i + 1}/{n_chunks}")
+        check_deadline(f"transfer chunk {i + 1}/{n_chunks}", stage="staging")
     with jax.default_device(device):
         out = jnp.concatenate(parts, axis=0)
     jax.block_until_ready(out)
     return out
 
 
-def time_fit(model, bins, y, rounds, device, method):
+def time_fit(model, bins, y, rounds, device, method,
+             transfer_path="bench_stage"):
     """Time fit with each backend's best hist algorithm.
 
-    `bins` may arrive as uint8 (the tunnel-frugal wire format — 4x fewer
-    bytes host->device than int32); it is widened on-device before the
-    timed region, so the fit itself always sees int32 exactly as before.
+    `bins` arrives in the binned wire dtype (uint8 at 256 bins — the
+    device-feed format, bridge/binning.py).  The dataset is STAGED
+    DEVICE-SIDE ONCE, outside the timed region, under a ``bench.stage``
+    span with transfer accounting; the fit widens to int32 on device
+    inside the compiled program (models/gbdt.py ``_widen_bins``), so the
+    tunnel carries the narrow bytes end to end.  ``transfer_path`` labels
+    the transfer counters — the CPU-baseline staging is a host->cpu0
+    copy, not tunnel traffic, and must not pollute the ``bench_stage``
+    series the detail.transfer_bytes contract is asserted against.
+    Returns ``(rows/sec, fit seconds, train acc, feed stats dict)``.
     """
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
+    from dmlc_core_tpu import telemetry
+
     fit = model._fit_fn(rounds, method)
-    log_stage(f"transfer to {device.platform}: bins "
-              f"{bins.nbytes / 1e6:.0f} MB ({bins.dtype}) + labels")
-    b = chunked_device_put(bins, device)
-    yy = jax.device_put(y, device)
-    w = jax.device_put(np.ones(len(y), np.float32), device)
+    w = np.ones(len(y), np.float32)
+    nbytes = int(bins.nbytes + y.nbytes + w.nbytes)
+    log_stage(f"staging on {device.platform}: bins "
+              f"{bins.nbytes / 1e6:.0f} MB ({bins.dtype}) + "
+              f"labels/weights {(y.nbytes + w.nbytes) / 1e6:.0f} MB")
+    stage_start = time.perf_counter()
+    with telemetry.span("bench.stage", device=device.platform,
+                        nbytes=nbytes, path=transfer_path):
+        b = chunked_device_put(bins, device)
+        yy = jax.device_put(y, device)
+        ww = jax.device_put(w, device)
+        jax.block_until_ready((b, yy, ww))
+    stage_s = time.perf_counter() - stage_start
+    telemetry.count("dmlc_transfer_bytes_total", nbytes, path=transfer_path)
+    telemetry.count("dmlc_transfer_seconds_total", stage_s,
+                    path=transfer_path, phase="dispatch")
+    feed = {
+        "transfer_bytes": nbytes,
+        "stage_seconds": round(stage_s, 3),
+        "feed_rows_per_sec": (round(len(y) / stage_s, 1) if stage_s > 0
+                              else None),
+        "wire_dtype": str(bins.dtype),
+    }
     with jax.default_device(device):
-        if b.dtype != jnp.int32:
-            b = jnp.asarray(b, jnp.int32)  # widen on-device, untimed
-        jax.block_until_ready(b)
-        log_stage(f"transfer done; compiling+warming fit on "
-                  f"{device.platform}")
+        log_stage(f"staged once in {stage_s:.2f}s "
+                  f"({len(y) / max(stage_s, 1e-9) / 1e6:.2f}M rows/s feed); "
+                  f"compiling+warming fit on {device.platform}")
         check_deadline("before compile")
-        _, margin = fit(b, yy, w)
+        _, margin = fit(b, yy, ww)
         jax.block_until_ready(margin)  # compile + warm
         log_stage("warm fit done; timing")
         check_deadline("before timed fit")
-        from dmlc_core_tpu import telemetry
         start = time.perf_counter()
         with telemetry.span("bench.timed_fit", device=device.platform,
                             rounds=rounds, method=method):
-            _, margin = fit(b, yy, w)
+            _, margin = fit(b, yy, ww)
             jax.block_until_ready(margin)
         elapsed = time.perf_counter() - start
     log_stage(f"timed fit done: {elapsed:.3f}s")
     acc = float(((np.asarray(margin) > 0) == np.asarray(y)).mean())
-    return len(y) * rounds / elapsed, elapsed, acc
+    return len(y) * rounds / elapsed, elapsed, acc, feed
 
 
 def _i8_state() -> bool:
@@ -294,8 +335,9 @@ def run_bench(force_cpu):
     import numpy as np
 
     from dmlc_core_tpu import telemetry
+    from dmlc_core_tpu.bridge.binning import HostBinner
     from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
-    from dmlc_core_tpu.ops.histogram import apply_bins, resolve_hist_method
+    from dmlc_core_tpu.ops.histogram import resolve_hist_method
 
     # Per-stage attribution for the BENCH round: collect the whole child run
     # (parser/threadediter/collective metric families land in the registry)
@@ -316,21 +358,23 @@ def run_bench(force_cpu):
     accel = jax.devices()[0]
     platform = accel.platform
     on_accel = platform != "cpu"
-    # Binning is untimed setup: run it on the HOST backend and ship only
-    # the compact uint8 bins to the accelerator.  Binning on the
-    # accelerator costs x (f32) up + bins (i32) back + bins up again —
-    # ~3x the bytes through the axon tunnel, whose host<->device
-    # bandwidth, not the chip, dominated the r5 2M-row attempt.
     cpu0 = jax.devices("cpu")[0]
-    with jax.default_device(cpu0):
-        bins = np.asarray(apply_bins(x, model.boundaries))
-    bins = bins.astype(np.uint8 if NUM_BINS <= 256 else np.int32)
+    # Binning is untimed setup and runs ON THE HOST (bridge/binning.py's
+    # numpy searchsorted — no jax backend round-trip at all): the wire
+    # then carries the uint8 bins once.  The old device-side-binning path
+    # cost x (f32) up + bins (i32) back + bins (i32) up again — 12x the
+    # bytes through the axon tunnel, whose host<->device bandwidth, not
+    # the chip, dominated the r5 2M-row attempt.
+    binner = HostBinner(model.boundaries, NUM_BINS,
+                        handle_missing=param.handle_missing)
+    with telemetry.span("bench.host_binning", rows=N_ROWS):
+        bins = binner.transform(x)
     log_stage(f"host-side binning done ({bins.dtype}, {bins.nbytes/1e6:.0f} MB)")
 
     accel_method = resolve_hist_method("auto")
     accel_rounds = TPU_ROUNDS if on_accel else CPU_ROUNDS
-    accel_rps, accel_s, acc = time_fit(model, bins, y, accel_rounds, accel,
-                                       accel_method)
+    accel_rps, accel_s, acc, feed = time_fit(model, bins, y, accel_rounds,
+                                             accel, accel_method)
     mode = "--child-cpu" if force_cpu else "--child"
     # The accelerator number is the measurement of record: persist it the
     # moment it exists, so a soft-deadline abort in the baseline phase
@@ -349,9 +393,9 @@ def run_bench(force_cpu):
     cpu_baseline_note = None
     if on_accel:
         try:
-            cpu_rps, cpu_s, _ = time_fit(model, bins[:baseline_cap],
-                                         y[:baseline_cap], CPU_ROUNDS, cpu0,
-                                         "scatter")
+            cpu_rps, cpu_s, _, _ = time_fit(
+                model, bins[:baseline_cap], y[:baseline_cap], CPU_ROUNDS,
+                cpu0, "scatter", transfer_path="bench_stage_baseline")
             if baseline_cap < N_ROWS:
                 cpu_baseline_note = f"baseline on {baseline_cap} rows"
         except SoftDeadline as e:
@@ -413,6 +457,16 @@ def run_bench(force_cpu):
             "seconds": round(accel_s, 3),
             "cpu_rows_per_sec": round(cpu_rps, 1) if cpu_rps else None,
             "train_acc": round(acc, 4),
+            # device-feed accounting (ISSUE 9): the staged-once wire cost
+            # and feed rate travel with the train figure, against the
+            # pre-PR float path's bytes for the same shape (x f32 up +
+            # bins i32 back + bins i32 up) — the >=8x wire-reduction
+            # contract is asserted in tests/test_bench_contract.py
+            "transfer_bytes": feed["transfer_bytes"],
+            "feed_rows_per_sec": feed["feed_rows_per_sec"],
+            "stage_seconds": feed["stage_seconds"],
+            "wire_dtype": feed["wire_dtype"],
+            "float_path_bytes": 3 * N_ROWS * N_FEATURES * 4,
         },
     }
     if cpu_baseline_note:
@@ -620,11 +674,18 @@ if __name__ == "__main__":
             # treats the attempt as failed, and no mid-RPC SIGKILL ever
             # reaches the tunnel client.  The flight dump records the last
             # spans before the watchdog fired (same artifact a hard
-            # timeout leaves, so both paths diagnose identically).
+            # timeout leaves, so both paths diagnose identically) — and
+            # carries the budgeted stage's name when the overage happened
+            # inside one (soft_deadline_staging = transfer-bound wedge,
+            # named explicitly).  Only this FATAL path dumps: a recovered
+            # overage (the CPU-baseline catch in run_bench) leaves no
+            # bogus wedge evidence beside a successful result.
             try:
                 from dmlc_core_tpu import telemetry
 
-                telemetry.flight.dump("soft_deadline")
+                stage = getattr(e, "stage", None)
+                telemetry.flight.dump(f"soft_deadline_{stage}" if stage
+                                      else "soft_deadline")
             except Exception:
                 pass
             log_stage(str(e))

@@ -14,7 +14,14 @@ from dmlc_core_tpu.bridge.batching import (  # noqa: F401
     block_to_dense,
     block_to_sparse,
 )
-from dmlc_core_tpu.bridge.loader import MeshBatchLoader  # noqa: F401
+from dmlc_core_tpu.bridge.binning import (  # noqa: F401
+    BinnedBatch,
+    HostBinner,
+    binned_batches,
+    fit_binner,
+)
+from dmlc_core_tpu.bridge.loader import (MeshBatchLoader,  # noqa: F401
+                                         DeviceFeedLoader)
 from dmlc_core_tpu.bridge.checkpoint import (save_checkpoint,  # noqa: F401
                                              load_checkpoint,
                                              AsyncCheckpointer,

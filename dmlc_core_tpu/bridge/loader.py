@@ -10,20 +10,74 @@ are prefetched).
 Per-host data sharding reuses the InputSplit math unchanged: process p of N
 reads shard ``(part_index=p, num_parts=N)`` (SURVEY.md §7 stage 4), and
 ``jax.make_array_from_process_local_data`` assembles the global batch.
+
+:class:`DeviceFeedLoader` is the explicit double-buffered device-feed mode
+(ROADMAP item 1): it keeps ``prefetch`` transfers *dispatched* ahead of the
+consumer, so the host->device copy of batch k+1 overlaps compute on batch
+k, and every transfer is accounted — ``loader.transfer`` spans plus the
+``dmlc_transfer_{bytes,seconds}_total`` counters — so the trace CLI's
+critical path splits transfer from compute.  Feed it
+:func:`~dmlc_core_tpu.bridge.binning.binned_batches` and the wire carries
+uint8 bin ids instead of float32 features (~1/12 the bytes for the
+hist-GBDT shape; see ``bridge/binning.py``).
 """
 
 from __future__ import annotations
 
-from typing import Any, Iterator, Optional
+from collections import deque
+from typing import Any, Callable, Iterator, Optional
 
 import numpy as np
 
+from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.bridge.batching import dense_batches, sparse_batches
 from dmlc_core_tpu.data.parser import Parser
 from dmlc_core_tpu.io.threadediter import ThreadedIter, IteratorProducer
+from dmlc_core_tpu.telemetry import clock
 from dmlc_core_tpu.utils.logging import CHECK
 
-__all__ = ["MeshBatchLoader"]
+__all__ = ["MeshBatchLoader", "DeviceFeedLoader", "batch_nbytes"]
+
+
+def batch_nbytes(batch: Any) -> int:
+    """Total array-leaf bytes of a host batch pytree (what a transfer of
+    it ships over the wire)."""
+    import jax
+
+    return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(batch)
+               if hasattr(leaf, "nbytes"))
+
+
+def _record_transfer(path: str, nbytes: int, seconds: float,
+                     phase: str) -> None:
+    """One transfer accounting row: bytes move once (dispatch), seconds
+    split by phase so dispatch cost and non-overlapped wait stay separate
+    series (observability.md catalog)."""
+    if phase == "dispatch":
+        telemetry.count("dmlc_transfer_bytes_total", nbytes, path=path)
+    telemetry.count("dmlc_transfer_seconds_total", seconds, path=path,
+                    phase=phase)
+
+
+def _accounted_place(inner: Callable[[Any], Any],
+                     path: str) -> Callable[[Any], Any]:
+    """Wrap a placement fn with the transfer accounting every feed path
+    shares — ``loader.transfer`` span + byte/latency counters — so the
+    mesh-shard and device-feed modes can never drift apart on how a
+    transfer is recorded.  Zero-cost when telemetry is disabled."""
+
+    def place(host_batch):
+        if not telemetry.enabled():
+            return inner(host_batch)
+        nbytes = batch_nbytes(host_batch)
+        start = clock.monotonic()
+        with telemetry.span("loader.transfer", path=path, nbytes=nbytes):
+            placed = inner(host_batch)
+        _record_transfer(path, nbytes, clock.monotonic() - start,
+                         "dispatch")
+        return placed
+
+    return place
 
 
 class MeshBatchLoader:
@@ -40,6 +94,12 @@ class MeshBatchLoader:
       nnz_bucket: optional fixed bucket for sparse form (else auto ladder —
         note each new bucket size triggers one recompile of the consumer).
       prefetch: host batches staged ahead (ThreadedIter capacity).
+      device_prefetch: device transfers kept dispatched ahead of the
+        consumer (0 = the legacy synchronous shard-on-demand path).  With
+        N >= 1 the loader runs double-buffered: while the consumer
+        computes on batch k, transfers of batches k+1..k+N are already in
+        flight — the :class:`DeviceFeedLoader` discipline applied to the
+        mesh path.
     """
 
     def __init__(
@@ -53,6 +113,7 @@ class MeshBatchLoader:
         data_axis: str = "data",
         prefetch: int = 2,
         drop_remainder: bool = True,
+        device_prefetch: int = 0,
     ):
         import jax
 
@@ -62,9 +123,11 @@ class MeshBatchLoader:
         nproc = jax.process_count()
         CHECK(global_batch_size % nproc == 0,
               "global_batch_size must divide evenly across processes")
+        CHECK(device_prefetch >= 0, "device_prefetch must be >= 0")
         self._local_rows = global_batch_size // nproc
         self._global_batch = global_batch_size
         self._num_feature = num_feature
+        self._device_prefetch = device_prefetch
         if form == "dense":
             CHECK(num_feature is not None, "dense form requires num_feature")
             factory = lambda: dense_batches(  # noqa: E731
@@ -77,6 +140,12 @@ class MeshBatchLoader:
         self._parser = parser
         self._host_iter = ThreadedIter(_EpochProducer(parser, factory),
                                        max_capacity=prefetch, name="loader")
+        # device-prefetch in-flight batches live on the LOADER, not in the
+        # iterator: an abandoned mid-epoch iteration (break / islice) must
+        # hand its already-dispatched batches to the next one, or they
+        # silently vanish from the epoch (the sync path pulls lazily and
+        # loses nothing — byte-identity demands the buffered path match)
+        self._pending: deque = deque()
 
     def _shard(self, host_batch):
         import jax
@@ -96,14 +165,26 @@ class MeshBatchLoader:
         # and num_rows is static aux data (host-local, never device-placed)
         return jax.tree_util.tree_map(place, host_batch)
 
-    def __iter__(self) -> Iterator[Any]:
+    def _host_batches(self) -> Iterator[Any]:
         while True:
             host_batch = self._host_iter.next()
             if host_batch is None:
                 return
-            yield self._shard(host_batch)
+            yield host_batch
+
+    def __iter__(self) -> Iterator[Any]:
+        place = _accounted_place(self._shard, "mesh_shard")
+        if not self._device_prefetch:
+            for host_batch in self._host_batches():
+                yield place(host_batch)
+            return
+        yield from _double_buffered(self._host_batches(), place,
+                                    self._device_prefetch,
+                                    path="mesh_shard",
+                                    pending=self._pending)
 
     def before_first(self) -> None:
+        self._pending.clear()
         self._host_iter.before_first()
 
     def bytes_read(self) -> int:
@@ -113,6 +194,112 @@ class MeshBatchLoader:
         self._host_iter.destroy()
         if hasattr(self._parser, "close"):
             self._parser.close()
+
+
+def _double_buffered(host_batches: Iterator[Any], place: Callable[[Any], Any],
+                     prefetch: int, path: str,
+                     pending: Optional[deque] = None) -> Iterator[Any]:
+    """The double-buffer core: keep ``prefetch`` placed batches dispatched
+    ahead, block for readiness only at hand-off.  JAX transfers are async
+    once dispatched, so the wait measured here is exactly the
+    non-overlapped transfer residue — when it is ~0, transfer fully hides
+    behind compute (the trace-CLI critical-path signal).
+
+    ``pending`` may be a caller-owned deque: dispatched-but-unconsumed
+    batches then survive an abandoned iteration and are yielded first by
+    the next one (MeshBatchLoader resumes mid-epoch; a local deque would
+    silently drop up to ``prefetch`` batches on break/resume)."""
+    import jax
+
+    if pending is None:
+        pending = deque()
+    while True:
+        while len(pending) < prefetch:
+            try:
+                host_batch = next(host_batches)
+            except StopIteration:
+                break
+            pending.append(place(host_batch))
+        if not pending:
+            return
+        batch = pending.popleft()
+        if telemetry.enabled():
+            start = clock.monotonic()
+            with telemetry.span("loader.transfer.wait", path=path):
+                jax.block_until_ready(batch)
+            _record_transfer(path, 0, clock.monotonic() - start, "wait")
+        yield batch
+
+
+class DeviceFeedLoader:
+    """Double-buffered async device feed over any restartable batch source.
+
+    ``source`` is either a zero-arg factory returning one epoch's iterator
+    of host batch pytrees (e.g. ``lambda: binned_batches(parser, binner,
+    bs)``), or an object with ``before_first()`` + iteration (a
+    :class:`MeshBatchLoader`-shaped host iterator).  Each ``__iter__``
+    starts a fresh epoch; ``before_first()`` is the explicit restart for
+    source objects that need it.
+
+    ``place`` maps a host batch to its device form — default
+    ``jax.device_put`` onto ``device`` (or ``sharding``); override it to
+    fuse extra staging (e.g. a device-side widen).  The loader keeps
+    ``prefetch`` transfers dispatched ahead of the consumer and records
+    per-batch ``loader.transfer`` spans + ``dmlc_transfer_bytes_total`` /
+    ``dmlc_transfer_seconds_total{phase=dispatch|wait}`` so the merged
+    trace shows transfer vs compute (docs/observability.md).
+
+    Determinism contract (tested): the batch sequence is byte-identical
+    to placing the same host batches synchronously — buffering reorders
+    *time*, never data — including across a full ``before_first()`` epoch
+    restart.
+    """
+
+    def __init__(self, source: Any, device: Any = None, sharding: Any = None,
+                 prefetch: int = 2,
+                 place: Optional[Callable[[Any], Any]] = None):
+        CHECK(prefetch >= 1, "prefetch must be >= 1")
+        CHECK(device is None or sharding is None,
+              "pass device= or sharding=, not both")
+        self._source = source
+        self._prefetch = prefetch
+        self._device = device
+        self._sharding = sharding
+        self._place = place
+
+    def _epoch(self) -> Iterator[Any]:
+        if callable(self._source):
+            return iter(self._source())
+        if hasattr(self._source, "before_first"):
+            self._source.before_first()
+        return iter(self._source)
+
+    def _placer(self) -> Callable[[Any], Any]:
+        if self._place is not None:
+            inner = self._place
+        else:
+            import jax
+
+            target = self._sharding if self._sharding is not None \
+                else self._device
+
+            def inner(host_batch):
+                if target is None:
+                    return jax.device_put(host_batch)
+                return jax.device_put(host_batch, target)
+
+        return _accounted_place(inner, "device_feed")
+
+    def __iter__(self) -> Iterator[Any]:
+        yield from _double_buffered(self._epoch(), self._placer(),
+                                    self._prefetch, path="device_feed")
+
+    def before_first(self) -> None:
+        """Restart the underlying source (factory sources restart per
+        ``__iter__`` anyway; this forwards to object sources)."""
+        if not callable(self._source) and hasattr(self._source,
+                                                  "before_first"):
+            self._source.before_first()
 
 
 class _EpochProducer:
@@ -135,3 +322,10 @@ class _EpochProducer:
         except StopIteration:
             self._it = None
             return None
+        except BaseException:
+            # a mid-epoch failure leaves the iterator a corpse: a later
+            # next() would raise StopIteration off it and read as a clean
+            # (silently truncated!) epoch end.  Drop it so the next pull
+            # restarts the factory and before_first() recovers cleanly.
+            self._it = None
+            raise

@@ -236,6 +236,15 @@ class DiskRowIter(RowBlockIter):
 
         return _Producer()
 
+    def cache_blocks(self) -> Optional[list]:
+        """The mmap'd zero-copy RowBlock views backing a v2 cache (the
+        same objects every epoch), or None on the v1/stream path.
+
+        This is the streaming-binner feed (``bridge.binning.fit_binner``):
+        quantile edges are computed directly over the mapped views without
+        a second parse or any row copy."""
+        return None if self._reader is None else self._reader.blocks
+
     def before_first(self) -> None:
         if self._iter is None:
             self._iter = ThreadedIter(self._make_producer(), max_capacity=2,

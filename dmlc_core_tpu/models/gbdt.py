@@ -136,6 +136,19 @@ class TreeEnsemble(NamedTuple):
         return self.split_feat.shape[0]
 
 
+def _widen_bins(bins):
+    """Accept pre-binned features in the uint8/uint16 wire dtype (the
+    tunnel-frugal device feed, ``bridge/binning.py``): widen to int32 *on
+    device, inside the jit*, so the host->device transfer ships the narrow
+    bytes and every downstream compare/select/gather sees exactly the
+    int32 the on-device ``apply_bins`` path produces — split decisions are
+    bitwise-identical by construction (tests/test_device_feed.py)."""
+    import jax.numpy as jnp
+
+    bins = jnp.asarray(bins)
+    return bins if bins.dtype == jnp.int32 else bins.astype(jnp.int32)
+
+
 def _grad_hess(margin, label, objective: str):
     import jax.numpy as jnp
 
@@ -636,6 +649,23 @@ class GBDT:
             sample, eff_bins, comm=comm, count=count)
         return self.boundaries
 
+    def set_boundaries(self, boundaries: np.ndarray) -> None:
+        """Install externally computed quantile boundaries — e.g. a
+        streaming :class:`~dmlc_core_tpu.bridge.binning.HostBinner`'s
+        (``model.set_boundaries(binner.boundaries)``) — instead of
+        :meth:`make_bins`' sample fit.  The shape contract is the same:
+        ``[num_feature, eff_bins - 1]`` where the sparsity-aware mode
+        reserves the last bin id for missing values."""
+        boundaries = np.asarray(boundaries, dtype=np.float32)
+        eff_bins = (self.param.num_bins - 1 if self.param.handle_missing
+                    else self.param.num_bins)
+        CHECK(boundaries.shape == (self.num_feature, eff_bins - 1),
+              f"boundaries shape {boundaries.shape} != "
+              f"{(self.num_feature, eff_bins - 1)} (num_bins="
+              f"{self.param.num_bins}, handle_missing="
+              f"{self.param.handle_missing})")
+        self.boundaries = boundaries
+
     def bin_features(self, x):
         CHECK(self.boundaries is not None, "call make_bins first")
         miss = (self.param.num_bins - 1 if self.param.handle_missing
@@ -688,6 +718,7 @@ class GBDT:
         p = self.param
 
         def one_round(margin, bins, label, weight, rnd):
+            bins = _widen_bins(bins)
             onehot = (bin_onehot(bins, p.num_bins)
                       if method == "onehot" else None)
 
@@ -745,6 +776,9 @@ class GBDT:
         def fit(bins, label, weight, ev_bins=None, ev_label=None):
             import jax.numpy as jnp
 
+            bins = _widen_bins(bins)
+            if ev_bins is not None:
+                ev_bins = _widen_bins(ev_bins)
             n_rows = bins.shape[0]
             if method in ("pallas", "pallas_fused"):
                 from dmlc_core_tpu.ops.hist_pallas import BLOCK_ROWS
@@ -837,6 +871,7 @@ class GBDT:
                    else -1)
 
         def predict(ensemble: TreeEnsemble, bins):
+            bins = _widen_bins(bins)
             B = bins.shape[0]
             multiclass = ensemble.split_feat.ndim == 3
 
@@ -939,7 +974,8 @@ class GBDT:
                    else -1)
 
         def one_tree(sf, sb, lv, dl, bins):
-            return _predict_tree(sf, sb, lv, dl, bins, d, miss_id)
+            return _predict_tree(sf, sb, lv, dl, _widen_bins(bins), d,
+                                 miss_id)
 
         return jax.jit(one_tree)
 
@@ -1062,6 +1098,7 @@ class GBDT:
         K = p.num_class if p.objective == "softmax" else 1
 
         def staged(ensemble, bins, label):
+            bins = _widen_bins(bins)
             B = bins.shape[0]
 
             def body(margin, tree):
@@ -1094,6 +1131,7 @@ class GBDT:
                    else -1)
 
         def leaves(ensemble, bins):
+            bins = _widen_bins(bins)
             multiclass = ensemble.split_feat.ndim == 3
 
             def body(_, tree):

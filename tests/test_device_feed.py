@@ -1,0 +1,470 @@
+"""Device-feed pipeline tests (ISSUE 9): host binning determinism vs the
+float path, double-buffered loader byte-identity, transfer telemetry, and
+the epoch-boundary robustness of the loader's producer.
+
+The load-bearing guarantees:
+
+- training on host-binned uint8 wire bins makes bitwise-identical split
+  decisions to the on-device float->apply_bins path (same searchsorted
+  semantics host and device, widened to int32 inside the jit);
+- the double-buffered DeviceFeedLoader reorders *time*, never data — the
+  batch sequence is byte-identical to the synchronous path, including
+  across a full before_first() epoch restart;
+- every transfer is accounted (loader.transfer spans +
+  dmlc_transfer_{bytes,seconds}_total) on BOTH the new device-feed mode
+  and the pre-existing MeshBatchLoader._shard path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dmlc_core_tpu import telemetry
+from dmlc_core_tpu.bridge.batching import dense_batches
+from dmlc_core_tpu.bridge.binning import (BinnedBatch, HostBinner,
+                                          binned_batches, fit_binner,
+                                          wire_dtype)
+from dmlc_core_tpu.bridge.loader import (DeviceFeedLoader, MeshBatchLoader,
+                                         _EpochProducer, batch_nbytes)
+from dmlc_core_tpu.data.factory import create_parser
+from dmlc_core_tpu.io.threadediter import ThreadedIter
+from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+from dmlc_core_tpu.ops.histogram import apply_bins
+from dmlc_core_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    if was_enabled:
+        telemetry.enable()
+
+
+def make_xy(n=3000, f=7, seed=0, nan_rate=0.0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float32)
+    if nan_rate:
+        x[rng.rand(n, f) < nan_rate] = np.nan
+    w = rng.randn(f).astype(np.float32)
+    y = ((np.nan_to_num(x) @ w) > 0).astype(np.float32)
+    return x, y
+
+
+def counter_value(name, **labels):
+    fam = telemetry.snapshot()["metrics"].get(name, {"samples": []})
+    return sum(s["value"] for s in fam["samples"]
+               if all(s["labels"].get(k) == v for k, v in labels.items()))
+
+
+def span_names():
+    return [e["name"] for e in telemetry.get_tracer().events()]
+
+
+# -- host binner vs the on-device float path ---------------------------------
+
+def test_host_binner_matches_apply_bins_bitwise():
+    x, _ = make_xy(5000, 6, seed=1)
+    # adversarial values: exact boundary hits, +-inf, huge magnitudes
+    x[0, :] = 0.0
+    x[1, :] = np.inf
+    x[2, :] = -np.inf
+    x[3, :] = 1e30
+    model = GBDT(GBDTParam(num_bins=64), num_feature=6)
+    model.make_bins(x[:2000])
+    binner = HostBinner(model.boundaries, 64)
+    host = binner.transform(x)
+    dev = np.asarray(apply_bins(x, model.boundaries))
+    assert host.dtype == np.uint8
+    np.testing.assert_array_equal(host.astype(np.int32), dev)
+
+
+def test_host_binner_matches_apply_bins_missing_mode():
+    x, _ = make_xy(4000, 5, seed=2, nan_rate=0.15)
+    model = GBDT(GBDTParam(num_bins=64, handle_missing=True), num_feature=5)
+    model.make_bins(x[:2000])
+    binner = HostBinner(model.boundaries, 64, handle_missing=True)
+    host = binner.transform(x)
+    dev = np.asarray(apply_bins(x, model.boundaries, missing_bin=63))
+    np.testing.assert_array_equal(host.astype(np.int32), dev)
+    assert (host[np.isnan(x)] == 63).all()
+
+
+def test_prebinned_uint8_training_identical_to_float_path():
+    """The tentpole contract: uint8 wire bins -> bitwise-equal trees."""
+    x, y = make_xy(3000, 7, seed=0)
+    param = GBDTParam(num_boost_round=4, max_depth=4, num_bins=256,
+                      learning_rate=0.3)
+    model = GBDT(param, num_feature=7)
+    model.make_bins(x[:1000])
+    wire = HostBinner(model.boundaries, 256).transform(x)
+    assert wire.dtype == np.uint8
+    ens_w, margin_w = model.fit_binned(wire, y)
+    ens_f, margin_f = model.fit_binned(np.asarray(model.bin_features(x)), y)
+    for a, b in zip(ens_w[:4], ens_f[:4]):  # feat/bin/leaf/default_left
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(margin_w),
+                                  np.asarray(margin_f))
+    # predict accepts the wire dtype too, and agrees bitwise
+    np.testing.assert_array_equal(
+        np.asarray(model.predict(ens_w, wire[:64])),
+        np.asarray(model.predict(ens_f,
+                                 np.asarray(model.bin_features(x[:64])))))
+
+
+def test_prebinned_training_identical_missing_mode():
+    x, y = make_xy(2000, 5, seed=3, nan_rate=0.2)
+    param = GBDTParam(num_boost_round=3, max_depth=3, num_bins=64,
+                      handle_missing=True)
+    model = GBDT(param, num_feature=5)
+    model.make_bins(x[:800])
+    wire = HostBinner(model.boundaries, 64,
+                      handle_missing=True).transform(x)
+    ens_w, _ = model.fit_binned(wire, y)
+    ens_f, _ = model.fit_binned(np.asarray(model.bin_features(x)), y)
+    np.testing.assert_array_equal(np.asarray(ens_w.split_feat),
+                                  np.asarray(ens_f.split_feat))
+    np.testing.assert_array_equal(np.asarray(ens_w.split_bin),
+                                  np.asarray(ens_f.split_bin))
+    np.testing.assert_array_equal(np.asarray(ens_w.default_left),
+                                  np.asarray(ens_f.default_left))
+
+
+def test_set_boundaries_installs_streamed_edges():
+    x, y = make_xy(1500, 4, seed=4)
+    binner = fit_binner([x[:700], x[700:]], 32)
+    model = GBDT(GBDTParam(num_boost_round=2, num_bins=32, max_depth=3),
+                 num_feature=4)
+    model.set_boundaries(binner.boundaries)
+    ens, _ = model.fit_binned(binner.transform(x), y)
+    assert np.asarray(ens.split_feat).shape[0] == 2
+    with pytest.raises(Exception):
+        model.set_boundaries(np.zeros((4, 5), np.float32))  # wrong width
+
+
+# -- binning edge cases -------------------------------------------------------
+
+def test_binning_constant_column():
+    x = np.ones((500, 3), np.float32)
+    x[:, 1] = np.arange(500, dtype=np.float32)
+    binner = fit_binner(x, 16)
+    ids = binner.transform(x)
+    # constant columns collapse to one id; varying column spreads
+    assert len(np.unique(ids[:, 0])) == 1
+    assert len(np.unique(ids[:, 1])) > 8
+    dev = np.asarray(apply_bins(x, binner.boundaries))
+    np.testing.assert_array_equal(ids.astype(np.int32), dev)
+
+
+def test_binning_nan_without_missing_mode_matches_device():
+    x, _ = make_xy(800, 3, seed=5, nan_rate=0.1)
+    binner = fit_binner(np.nan_to_num(x), 32)
+    np.testing.assert_array_equal(
+        binner.transform(x).astype(np.int32),
+        np.asarray(apply_bins(x, binner.boundaries)))
+
+
+def test_binning_many_distinct_values_saturates_ladder():
+    rng = np.random.RandomState(6)
+    x = rng.rand(20000, 2).astype(np.float32)  # >> 256 distinct values
+    binner = fit_binner(x, 256)
+    ids = binner.transform(x)
+    assert ids.dtype == np.uint8
+    assert ids.max() == 255 and ids.min() == 0
+    # quantile property: every bin carries mass (uniform data)
+    counts = np.bincount(ids[:, 0], minlength=256)
+    assert (counts > 0).all()
+
+
+def test_wire_dtype_ladder():
+    assert wire_dtype(256) == np.uint8
+    assert wire_dtype(257) == np.uint16
+    assert wire_dtype(65536) == np.uint16
+    assert wire_dtype(65537) == np.int32
+    with pytest.raises(Exception):
+        wire_dtype(1)
+
+
+def test_fit_binner_empty_source_rejected():
+    with pytest.raises(Exception):
+        fit_binner([], 16)
+
+
+# -- streaming sources --------------------------------------------------------
+
+def write_libsvm(tmp_path, n=100):
+    lines = [f"{i % 2} 0:{i} 2:{(i * 7) % 13}" for i in range(n)]
+    p = tmp_path / "data.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_fit_binner_over_parser_blocks(tmp_path):
+    uri = write_libsvm(tmp_path, 200)
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    binner = fit_binner(parser, 16, num_feature=3)
+    assert binner.boundaries.shape == (3, 15)
+    ids = binner.transform(np.asarray([[0.0, 0.0, 0.0],
+                                       [199.0, 0.0, 12.0]], np.float32))
+    assert ids.shape == (2, 3) and ids.dtype == np.uint8
+    assert ids[1, 0] > ids[0, 0]
+
+
+def test_fit_binner_over_page_cache_views(tmp_path):
+    """The zero-copy path the ROADMAP names: edges streamed directly off
+    the mmap'd v2 cache's RowBlock views."""
+    from dmlc_core_tpu.data.iterators import DiskRowIter
+
+    uri = write_libsvm(tmp_path, 300)
+    cache = str(tmp_path / "cache.v2")
+    it = DiskRowIter(create_parser(uri, type="libsvm", threaded=False),
+                     cache)
+    try:
+        blocks = it.cache_blocks()
+        assert blocks is not None  # v2 mmap engaged
+        binner = fit_binner(blocks, 16, num_feature=3)
+        assert binner.boundaries.shape == (3, 15)
+    finally:
+        it.close()
+
+
+def test_binned_batches_pipeline(tmp_path):
+    uri = write_libsvm(tmp_path, 100)
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    binner = fit_binner(np.arange(300, dtype=np.float32).reshape(100, 3),
+                        16)
+    parser2 = create_parser(uri, type="libsvm", threaded=False)
+    batches = list(binned_batches(parser2, binner, batch_size=32))
+    assert len(batches) == 4
+    for b in batches[:3]:
+        assert isinstance(b, BinnedBatch)
+        assert b.bins.shape == (32, 3) and b.bins.dtype == np.uint8
+        assert b.num_rows == 32
+    tail = batches[-1]
+    assert tail.num_rows == 4
+    assert (tail.weight[4:] == 0).all()  # padding mask contract
+
+
+def test_binned_batch_passes_through_jit():
+    b = BinnedBatch(np.zeros((8, 3), np.uint8), np.zeros(8, np.float32),
+                    np.ones(8, np.float32), num_rows=8)
+
+    @jax.jit
+    def rows(batch):
+        return jnp.sum(batch.bins.astype(jnp.int32)) + batch.label.sum()
+
+    assert float(rows(b)) == 0.0
+    # num_rows is static aux data, readable under jit
+    leaves, treedef = jax.tree_util.tree_flatten(b)
+    assert len(leaves) == 3
+
+
+# -- double-buffered device feed ----------------------------------------------
+
+def host_batch_stream(n_batches=6, rows=64, f=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [BinnedBatch(rng.randint(0, 255, (rows, f)).astype(np.uint8),
+                        rng.randn(rows).astype(np.float32),
+                        np.ones(rows, np.float32), num_rows=rows)
+            for _ in range(n_batches)]
+
+
+@pytest.mark.parametrize("prefetch", [1, 2, 4])
+def test_device_feed_identical_to_sync_with_epoch_restart(prefetch):
+    batches = host_batch_stream()
+    sync = [jax.device_put(b) for b in batches]
+    loader = DeviceFeedLoader(lambda: iter(batches), prefetch=prefetch)
+    for epoch in range(2):  # second epoch == full before_first() restart
+        got = list(loader)
+        assert len(got) == len(sync)
+        for g, s in zip(got, sync):
+            np.testing.assert_array_equal(np.asarray(g.bins),
+                                          np.asarray(s.bins))
+            np.testing.assert_array_equal(np.asarray(g.label),
+                                          np.asarray(s.label))
+            assert g.bins.dtype == jnp.uint8  # wire dtype survives
+
+
+def test_device_feed_object_source_before_first():
+    class Source:
+        def __init__(self, batches):
+            self._b = batches
+            self.resets = 0
+
+        def before_first(self):
+            self.resets += 1
+
+        def __iter__(self):
+            return iter(self._b)
+
+    src = Source(host_batch_stream(3))
+    loader = DeviceFeedLoader(src, prefetch=2)
+    assert len(list(loader)) == 3
+    assert src.resets == 1
+    loader.before_first()
+    assert src.resets == 2
+    assert len(list(loader)) == 3
+
+
+def test_device_feed_transfer_telemetry():
+    telemetry.enable()
+    batches = host_batch_stream(4)
+    expect_bytes = sum(batch_nbytes(b) for b in batches)
+    loader = DeviceFeedLoader(lambda: iter(batches), prefetch=2)
+    list(loader)
+    assert counter_value("dmlc_transfer_bytes_total",
+                         path="device_feed") == expect_bytes
+    assert counter_value("dmlc_transfer_seconds_total", path="device_feed",
+                         phase="dispatch") > 0
+    names = span_names()
+    assert names.count("loader.transfer") == 4
+    assert names.count("loader.transfer.wait") == 4
+
+
+def test_device_feed_rejects_bad_args():
+    with pytest.raises(Exception):
+        DeviceFeedLoader(lambda: iter([]), prefetch=0)
+    with pytest.raises(Exception):
+        DeviceFeedLoader(lambda: iter([]), device=jax.devices()[0],
+                         sharding=object())
+
+
+# -- mesh loader: transfer accounting + device prefetch ----------------------
+
+def test_mesh_loader_shard_transfer_span(tmp_path):
+    """Satellite: the pre-existing _shard path shows up in trace critical
+    paths too, not just the new device-feed mode."""
+    telemetry.enable()
+    uri = write_libsvm(tmp_path, 128)
+    mesh = make_mesh({"data": 8})
+    parser = create_parser(uri, type="libsvm", threaded=False)
+    loader = MeshBatchLoader(parser, mesh, form="dense",
+                             global_batch_size=32, num_feature=3)
+    batches = list(loader)
+    loader.close()
+    assert len(batches) == 4
+    assert span_names().count("loader.transfer") == 4
+    # 32 rows x (3 f32 feats + label + weight) per batch, 4 batches
+    assert counter_value("dmlc_transfer_bytes_total",
+                         path="mesh_shard") == 4 * 32 * (3 + 1 + 1) * 4
+    assert counter_value("dmlc_transfer_seconds_total", path="mesh_shard",
+                         phase="dispatch") > 0
+
+
+def test_mesh_loader_device_prefetch_identical(tmp_path):
+    uri = write_libsvm(tmp_path, 128)
+    mesh = make_mesh({"data": 8})
+
+    def batches_with(dp):
+        parser = create_parser(uri, type="libsvm", threaded=False)
+        loader = MeshBatchLoader(parser, mesh, form="dense",
+                                 global_batch_size=32, num_feature=3,
+                                 device_prefetch=dp)
+        out = [(np.asarray(b.x), np.asarray(b.label)) for b in loader]
+        # epoch restart under device prefetch too
+        loader.before_first()
+        out += [(np.asarray(b.x), np.asarray(b.label)) for b in loader]
+        loader.close()
+        return out
+
+    sync = batches_with(0)
+    buffered = batches_with(2)
+    assert len(sync) == len(buffered) == 8
+    for (xs, ls), (xb, lb) in zip(sync, buffered):
+        np.testing.assert_array_equal(xs, xb)
+        np.testing.assert_array_equal(ls, lb)
+
+
+def test_mesh_loader_device_prefetch_survives_abandoned_iteration(tmp_path):
+    """Break/resume parity with the sync path: batches already dispatched
+    into the prefetch buffer when an iteration is abandoned must be
+    yielded by the next one, not silently dropped from the epoch."""
+    uri = write_libsvm(tmp_path, 128)
+    mesh = make_mesh({"data": 8})
+
+    def make_loader(dp):
+        parser = create_parser(uri, type="libsvm", threaded=False)
+        return MeshBatchLoader(parser, mesh, form="dense",
+                               global_batch_size=32, num_feature=3,
+                               device_prefetch=dp)
+
+    sync = make_loader(0)
+    expected = [np.asarray(b.x) for b in sync]
+    sync.close()
+    assert len(expected) == 4
+
+    loader = make_loader(2)
+    it = iter(loader)
+    first = np.asarray(next(it).x)       # up to 2 more are now in flight
+    del it                               # abandon mid-epoch
+    rest = [np.asarray(b.x) for b in loader]
+    np.testing.assert_array_equal(first, expected[0])
+    assert len(rest) == 3                # nothing vanished with the iterator
+    for got, want in zip(rest, expected[1:]):
+        np.testing.assert_array_equal(got, want)
+    # before_first drops the stale in-flight batches and restarts cleanly
+    loader.before_first()
+    fresh = [np.asarray(b.x) for b in loader]
+    assert len(fresh) == 4
+    np.testing.assert_array_equal(fresh[0], expected[0])
+    loader.close()
+
+
+# -- epoch-boundary robustness (satellite regression) ------------------------
+
+class _FlakyFactory:
+    """First epoch dies mid-iteration; later epochs are clean."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        return self._gen(self.calls)
+
+    @staticmethod
+    def _gen(call):
+        yield "a"
+        if call == 1:
+            raise RuntimeError("mid-epoch parse failure")
+        yield "b"
+
+
+class _NullParser:
+    def before_first(self):
+        pass
+
+
+def test_epoch_producer_resets_iterator_on_midepoch_error():
+    factory = _FlakyFactory()
+    prod = _EpochProducer(_NullParser(), factory)
+    assert prod.next(None) == "a"
+    with pytest.raises(RuntimeError):
+        prod.next(None)
+    # the dead iterator must NOT read as a clean epoch end: the next pull
+    # restarts the factory instead of returning None off the corpse
+    assert prod.next(None) == "a"
+    assert prod.next(None) == "b"
+    assert prod.next(None) is None
+
+
+def test_epoch_producer_recovers_through_threadediter():
+    factory = _FlakyFactory()
+    it = ThreadedIter(_EpochProducer(_NullParser(), factory),
+                      max_capacity=2, name="test_feed")
+    try:
+        assert it.next() == "a"
+        with pytest.raises(RuntimeError):
+            while True:
+                if it.next() is None:
+                    raise AssertionError("error was swallowed")
+        it.before_first()
+        assert [it.next(), it.next(), it.next()] == ["a", "b", None]
+    finally:
+        it.destroy()
