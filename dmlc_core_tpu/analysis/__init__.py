@@ -13,6 +13,15 @@ that subsystem rebuilt for what *this* codebase actually gets wrong:
   ``pjit`` / ``pallas_call`` / ``shard_map`` sites.
 - :mod:`.resources` — unclosed file/socket/tempfile handles in the io layer,
   temp dirs without a ``finally`` cleanup, and the no-``print`` style rule.
+- :mod:`.graph`     — the whole-repo module/call-graph core the project
+  passes share: import resolution, symbol tables, cross-module call edges,
+  partial/alias/annotation following.
+- :mod:`.deadlock`  — interprocedural lock-order cycles and unbounded
+  blocking calls made while holding a lock, over the project graph.
+- :mod:`.contracts` — cross-artifact drift: every ``DMLC_*`` knob,
+  ``dmlc_*`` metric, span name and fault site in code diffed against the
+  docs catalog tables (knob/span catalogs are generated via
+  ``--emit-knob-catalog`` / ``--emit-span-catalog``).
 - :mod:`.baseline`  — the ratchet: findings are keyed
   ``<file>:<rule>:<symbol>`` against a committed ``analysis_baseline.json``;
   new findings fail, baselined ones are burn-down work.

@@ -5,6 +5,18 @@ committed ``analysis_baseline.json`` (see :mod:`.baseline`): a finding whose
 key is baselined is burn-down work and does not fail the run; a finding with
 a new key does.  ``# dmlclint: disable=<rule>`` on (or on a comment line
 immediately above) the offending line suppresses it at the source.
+
+Two kinds of passes run:
+
+- **per-file passes** (lockset/purity/resources/protocol/transport) see one
+  module at a time;
+- **project passes** (deadlock/contracts) see the whole repo at once through
+  the :mod:`.graph` call-graph core — they run on the default (unscoped)
+  gate invocation, or whenever ``--pass`` selects them explicitly.
+
+``--format github`` renders new findings as GitHub workflow annotations;
+``--format sarif`` emits a SARIF 2.1.0 document (``--output`` writes it to
+a file for artifact upload).
 """
 
 from __future__ import annotations
@@ -12,20 +24,31 @@ from __future__ import annotations
 import argparse
 import ast
 import dataclasses
+import json
 import os
 import sys
 import re
 import tokenize
-from typing import Dict, Iterable, List, Optional, Sequence, Set
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = ["Finding", "FileContext", "analyze_source", "analyze_path",
-           "iter_python_files", "main", "ALL_RULES", "ROOT"]
+           "iter_python_files", "main", "ALL_RULES", "ROOT",
+           "PER_FILE_PASSES", "PROJECT_PASSES"]
 
 ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # the same target set the old scripts/lint.py walked
 TARGETS = ["dmlc_core_tpu", "tests", "examples", "bench.py",
            "__graft_entry__.py"]
+
+PER_FILE_PASSES = ("lockset", "purity", "resources", "protocol", "transport")
+PROJECT_PASSES = ("deadlock", "contracts")
+
+# non-library files that still get threading-discipline passes (bench.py
+# spawns watchdog/collector threads; its lock use is production code even
+# though it lives at the repo root) and ride in the project graph for the
+# deadlock/contracts passes
+EXTRA_DEEP: Dict[str, Tuple[str, ...]] = {"bench.py": ("lockset",)}
 
 # modules whose job is talking to a terminal: exempt from style-no-print
 CLI_EXEMPT = {
@@ -84,6 +107,47 @@ ALL_RULES = {
         "(data/parse_proc.py): array payloads must cross process "
         "boundaries as raw shm bytes, never pickled objects"),
     "style-no-print": "library code must log via utils.logging, not print()",
+    "deadlock-lock-cycle": (
+        "cycle in the global lock-order graph (interprocedural: holding A "
+        "and calling code that takes B orders A before B) — two threads "
+        "taking the locks in opposite order deadlock"),
+    "deadlock-blocking-under-lock": (
+        "unbounded blocking call (queue.get/.join()/.result()/.wait()/"
+        "socket recv without timeout) while holding a lock, directly or "
+        "through the call graph; every thread needing the lock wedges "
+        "behind the wait"),
+    "contract-undocumented-knob": (
+        "DMLC_* env var read in code but absent from every docs table — "
+        "regenerate the knob catalog (--emit-knob-catalog) or delete the "
+        "knob"),
+    "contract-undocumented-metric": (
+        "dmlc_* metric recorded in code but absent from the docs metric "
+        "catalogs"),
+    "contract-undocumented-span": (
+        "telemetry span/event name recorded in code but absent from the "
+        "docs span catalog (--emit-span-catalog regenerates it)"),
+    "contract-undocumented-site": (
+        "fault site injected but not registered in fault.SITES, or "
+        "registered but missing from the docs site table"),
+    "contract-stale-doc-entry": (
+        "a docs catalog row names a knob/metric/span/site the code no "
+        "longer has — prune the row or restore the artifact"),
+}
+
+# which pass owns which rule (drives --pass filtering of stale-entry
+# reporting and scoped baseline rewrites)
+RULES_BY_PASS: Dict[str, Tuple[str, ...]] = {
+    "lockset": ("lockset-unsync-write", "lockset-thread-leak",
+                "lockset-no-join"),
+    "purity": ("purity-host-sync", "purity-host-branch", "purity-np-call",
+               "purity-impure-call", "purity-telemetry-call"),
+    "resources": ("resource-unclosed", "resource-tempdir", "style-no-print"),
+    "protocol": ("assert-in-protocol",),
+    "transport": ("shm-no-pickle",),
+    "deadlock": ("deadlock-lock-cycle", "deadlock-blocking-under-lock"),
+    "contracts": ("contract-undocumented-knob", "contract-undocumented-metric",
+                  "contract-undocumented-span", "contract-undocumented-site",
+                  "contract-stale-doc-entry"),
 }
 
 
@@ -247,35 +311,73 @@ def suppressed_lines(source: str) -> Dict[int, Set[str]]:
 
 # -- per-file analysis --------------------------------------------------------
 
-def analyze_source(source: str, relpath: str = "<string>",
-                   is_library: Optional[bool] = None) -> List[Finding]:
-    """Run every pass over one source blob; returns sorted, unsuppressed
-    findings.  ``is_library`` defaults from the path (deep passes run on
-    ``dmlc_core_tpu/`` files; everything else is syntax-checked only)."""
-    relpath = relpath.replace(os.sep, "/")
-    if is_library is None:
-        is_library = relpath.startswith(LIBRARY_PREFIX)
-    try:
-        tree = ast.parse(source, relpath)
-    except SyntaxError as exc:
-        return [Finding("syntax", relpath, exc.lineno or 0, "<module>",
-                        f"syntax error: {exc.msg}")]
-    findings: List[Finding] = []
-    if is_library:
-        from dmlc_core_tpu.analysis import (lockset, protocol, purity,
-                                            resources, transport)
+def default_passes(relpath: str) -> Tuple[str, ...]:
+    """Per-file passes a path gets by default: the full set for library
+    code, a named subset for EXTRA_DEEP files, syntax-only otherwise."""
+    if relpath.startswith(LIBRARY_PREFIX):
+        return PER_FILE_PASSES
+    return EXTRA_DEEP.get(relpath, ())
 
-        ctx = FileContext(relpath, source, tree, is_library,
-                          cli_exempt=relpath in CLI_EXEMPT)
-        findings += lockset.run(ctx)
-        findings += purity.run(ctx)
-        findings += resources.run(ctx)
-        findings += protocol.run(ctx)
-        findings += transport.run(ctx)
-    supp = suppressed_lines(source)
-    findings = [f for f in findings
-                if not ({"all", f.rule} & supp.get(f.lineno, set()))]
+
+def _pass_runners():
+    from dmlc_core_tpu.analysis import (lockset, protocol, purity, resources,
+                                        transport)
+
+    return {"lockset": lockset.run, "purity": purity.run,
+            "resources": resources.run, "protocol": protocol.run,
+            "transport": transport.run}
+
+
+def _parse_tree(source: str,
+                relpath: str) -> Tuple[Optional[ast.Module],
+                                       Optional[Finding]]:
+    try:
+        return ast.parse(source, relpath), None
+    except SyntaxError as exc:
+        return None, Finding("syntax", relpath, exc.lineno or 0, "<module>",
+                             f"syntax error: {exc.msg}")
+
+
+def _apply_suppressions(findings: List[Finding],
+                        supp: Dict[int, Set[str]]) -> List[Finding]:
+    return [f for f in findings
+            if not ({"all", f.rule} & supp.get(f.lineno, set()))]
+
+
+def _analyze_context(ctx: FileContext,
+                     passes: Sequence[str]) -> List[Finding]:
+    """Per-file passes over an already-parsed context."""
+    findings: List[Finding] = []
+    if passes:
+        runners = _pass_runners()
+        for name in passes:
+            findings += runners[name](ctx)
+    findings = _apply_suppressions(findings, suppressed_lines(ctx.source))
     return sorted(findings, key=lambda f: (f.lineno, f.rule, f.symbol))
+
+
+def analyze_source(source: str, relpath: str = "<string>",
+                   is_library: Optional[bool] = None,
+                   passes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run per-file passes over one source blob; returns sorted,
+    unsuppressed findings.  ``passes`` selects a subset; ``is_library``
+    keeps the historical override (True = every per-file pass, False =
+    syntax only); by default the path decides (deep passes on
+    ``dmlc_core_tpu/`` files and the EXTRA_DEEP subset on ``bench.py``)."""
+    relpath = relpath.replace(os.sep, "/")
+    if passes is None:
+        if is_library is None:
+            passes = default_passes(relpath)
+        else:
+            passes = PER_FILE_PASSES if is_library else ()
+    tree, syntax = _parse_tree(source, relpath)
+    if tree is None:
+        return [syntax]
+    lib = (is_library if is_library is not None
+           else _project_scope(relpath))
+    ctx = FileContext(relpath, source, tree, lib,
+                      cli_exempt=relpath in CLI_EXEMPT)
+    return _analyze_context(ctx, passes)
 
 
 def repo_relpath(path: str, root: str = ROOT) -> str:
@@ -295,16 +397,9 @@ def repo_relpath(path: str, root: str = ROOT) -> str:
 
 def analyze_path(path: str, root: str = ROOT) -> List[Finding]:
     relpath = repo_relpath(path, root)
-    try:
-        # tokenize.open honors a PEP 263 `# -*- coding: ... -*-` line,
-        # which plain utf-8 open would reject on legacy files
-        with tokenize.open(path) as f:
-            source = f.read()
-    except (UnicodeDecodeError, LookupError, SyntaxError) as exc:
-        # undecodable bytes / bogus coding cookie: one finding, not a
-        # traceback that kills the whole gate
-        return [Finding("syntax", relpath, 0, "<module>",
-                        f"cannot decode source: {exc}")]
+    source, err = _read_source(path, relpath)
+    if source is None:
+        return [err]
     return analyze_source(source, relpath)
 
 
@@ -328,6 +423,123 @@ def iter_python_files(paths: Optional[Sequence[str]] = None,
                     yield os.path.join(dirpath, name)
 
 
+# -- project passes -----------------------------------------------------------
+
+def _project_scope(relpath: str) -> bool:
+    """Files that ride in the project graph (library code + EXTRA_DEEP)."""
+    return relpath.startswith(LIBRARY_PREFIX) or relpath in EXTRA_DEEP
+
+
+def _read_source(path: str, relpath: str) -> Tuple[Optional[str],
+                                                   Optional[Finding]]:
+    try:
+        # tokenize.open honors a PEP 263 `# -*- coding: ... -*-` line,
+        # which plain utf-8 open would reject on legacy files
+        with tokenize.open(path) as f:
+            return f.read(), None
+    except (UnicodeDecodeError, LookupError, SyntaxError) as exc:
+        # undecodable bytes / bogus coding cookie: one finding, not a
+        # traceback that kills the whole gate
+        return None, Finding("syntax", relpath, 0, "<module>",
+                             f"cannot decode source: {exc}")
+
+
+def _project_contexts(extra: Optional[Dict[str, "FileContext"]] = None
+                      ) -> List["FileContext"]:
+    """Parse every project-scope file under the default targets into
+    FileContexts, reusing already-parsed ones from ``extra``."""
+    extra = extra or {}
+    out: List[FileContext] = []
+    seen: Set[str] = set()
+    for path in iter_python_files(None):
+        relpath = repo_relpath(path)
+        if not _project_scope(relpath) or relpath in seen:
+            continue
+        seen.add(relpath)
+        if relpath in extra:
+            out.append(extra[relpath])
+            continue
+        source, err = _read_source(path, relpath)
+        if source is None:
+            continue  # the per-file sweep reports the decode error
+        tree, syntax = _parse_tree(source, relpath)
+        if tree is None:
+            continue
+        out.append(FileContext(relpath, source, tree, True,
+                               cli_exempt=relpath in CLI_EXEMPT))
+    return out
+
+
+def _run_project_passes(selected: Set[str],
+                        contexts: List["FileContext"]) -> List[Finding]:
+    """Deadlock/contracts over the whole-project graph; suppression
+    directives in the anchoring file apply exactly like per-file rules."""
+    from dmlc_core_tpu.analysis import contracts as contracts_mod
+    from dmlc_core_tpu.analysis import deadlock as deadlock_mod
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    graph = ProjectGraph(contexts)
+    findings: List[Finding] = []
+    if "deadlock" in selected:
+        findings += deadlock_mod.run_project(graph)
+    if "contracts" in selected:
+        findings += contracts_mod.run_project(
+            graph, contracts_mod.load_docs(ROOT))
+    supp_by_file: Dict[str, Dict[int, Set[str]]] = {}
+    for ctx in contexts:
+        supp_by_file[ctx.relpath] = suppressed_lines(ctx.source)
+    out: List[Finding] = []
+    for f in findings:
+        supp = supp_by_file.get(f.path)
+        if supp and ({"all", f.rule} & supp.get(f.lineno, set())):
+            continue
+        out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.lineno, f.rule, f.symbol))
+
+
+# -- output formats -----------------------------------------------------------
+
+def _github_annotation(f: Finding) -> str:
+    # '::error' annotations render inline on the PR diff; commas/newlines
+    # in properties must be %-escaped per the workflow-command grammar
+    msg = f.message.replace("%", "%25").replace("\r", "%0D") \
+        .replace("\n", "%0A")
+    return (f"::error file={f.path},line={f.lineno},"
+            f"title=dmlclint {f.rule} [{f.symbol}]::{msg}")
+
+
+def _sarif_document(findings: Sequence[Finding]) -> Dict:
+    rules = [{"id": rule,
+              "shortDescription": {"text": ALL_RULES[rule]}}
+             for rule in sorted(ALL_RULES)]
+    results = [{
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f"[{f.symbol}] {f.message}"},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(f.lineno, 1)},
+            },
+        }],
+    } for f in findings]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                    "master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dmlclint",
+                "informationUri": "docs/analysis.md",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
 # -- CLI ----------------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -336,8 +548,9 @@ def build_parser() -> argparse.ArgumentParser:
     argparse prefix abbreviations like ``--base`` for ``--baseline``)."""
     parser = argparse.ArgumentParser(
         prog="python -m dmlc_core_tpu.analysis",
-        description="dmlclint: lockset / JAX-purity / resource static "
-                    "analysis with a ratcheted baseline (docs/analysis.md)")
+        description="dmlclint: lockset / JAX-purity / resource / deadlock / "
+                    "contract static analysis with a ratcheted baseline "
+                    "(docs/analysis.md)")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: repo targets)")
     parser.add_argument("--baseline",
@@ -351,7 +564,52 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-v", "--verbose", action="store_true",
                         help="also print baselined findings")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--pass", dest="passes", action="append",
+                        metavar="PASS",
+                        help="run only the named pass(es) "
+                             f"({', '.join(PER_FILE_PASSES + PROJECT_PASSES)}"
+                             "; repeat or comma-separate; default: all). "
+                             "Project passes always analyze the whole repo")
+    parser.add_argument("--format", dest="fmt", default="text",
+                        choices=("text", "github", "sarif"),
+                        help="finding output format: text (default), github "
+                             "workflow annotations, or a SARIF 2.1.0 "
+                             "document")
+    parser.add_argument("--output", metavar="FILE",
+                        help="also write the SARIF document here (works "
+                             "with any --format; with --format sarif it "
+                             "replaces stdout output)")
+    parser.add_argument("--emit-knob-catalog", action="store_true",
+                        help="print the generated DMLC_* knob catalog "
+                             "markdown table and exit")
+    parser.add_argument("--emit-span-catalog", action="store_true",
+                        help="print the generated telemetry span catalog "
+                             "markdown table and exit")
     return parser
+
+
+def _selected_passes(args) -> Tuple[Set[str], bool]:
+    """(selected pass names, was --pass given explicitly)."""
+    every = set(PER_FILE_PASSES) | set(PROJECT_PASSES)
+    if not args.passes:
+        return every, False
+    out: Set[str] = set()
+    for spec in args.passes:
+        for name in spec.split(","):
+            name = name.strip()
+            if not name:
+                continue
+            if name not in every:
+                raise ValueError(
+                    f"unknown pass {name!r} (choose from "
+                    f"{', '.join(sorted(every))})")
+            out.add(name)
+    if not out:
+        # `--pass ""` (an unset shell variable in CI) must not silently
+        # disable every rule and green-light the gate
+        raise ValueError("--pass given but names no pass (choose from "
+                         f"{', '.join(sorted(every))})")
+    return out, True
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -361,7 +619,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.list_rules:
         for rule in sorted(ALL_RULES):
-            print(f"{rule:22s} {ALL_RULES[rule]}")
+            print(f"{rule:32s} {ALL_RULES[rule]}")
+        return 0
+
+    try:
+        selected, explicit_passes = _selected_passes(args)
+    except ValueError as exc:
+        print(f"dmlclint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.emit_knob_catalog or args.emit_span_catalog:
+        from dmlc_core_tpu.analysis import contracts as contracts_mod
+        from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+        graph = ProjectGraph(_project_contexts())
+        if args.emit_knob_catalog:
+            print(contracts_mod.render_knob_catalog(graph))
+        if args.emit_span_catalog:
+            print(contracts_mod.render_span_catalog(graph))
         return 0
 
     try:
@@ -370,8 +645,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"dmlclint: {exc}", file=sys.stderr)
         return 2
     findings: List[Finding] = []
+    parsed: Dict[str, FileContext] = {}
     for path in files:
-        findings += analyze_path(path)
+        relpath = repo_relpath(path)
+        source, err = _read_source(path, relpath)
+        if source is None:
+            findings.append(err)
+            continue
+        per_file = [p for p in default_passes(relpath) if p in selected]
+        tree, syntax = _parse_tree(source, relpath)
+        if tree is None:
+            findings.append(syntax)
+            continue
+        if per_file or _project_scope(relpath):
+            # context built once: shared by the per-file passes here and
+            # the project passes below (no re-parse)
+            ctx = FileContext(relpath, source, tree,
+                              _project_scope(relpath),
+                              cli_exempt=relpath in CLI_EXEMPT)
+            findings += _analyze_context(ctx, per_file)
+            if _project_scope(relpath):
+                parsed[relpath] = ctx
+
+    # project passes: on by default for the unscoped gate run; a scoped
+    # (path-argument) run skips them unless --pass asks — and then the
+    # graph is still built over the whole repo, because a partial call
+    # graph would under-approximate held-lock sets and doc obligations
+    project_selected = selected & set(PROJECT_PASSES)
+    project_ran = bool(project_selected
+                       and (not args.paths or explicit_passes))
+    if project_ran:
+        contexts = _project_contexts(extra=parsed)
+        findings += _run_project_passes(project_selected, contexts)
 
     try:
         # --no-baseline only changes *reporting*; a rewrite still loads the
@@ -382,14 +687,45 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as exc:
         print(f"dmlclint: {exc}", file=sys.stderr)
         return 2
+    # rules whose passes actually RAN this invocation: per-file passes in
+    # the selection always run; project-pass rules only count when the
+    # project passes ran (a plain scoped run skips them, so their baseline
+    # entries were never recomputed and must survive untouched).  Project
+    # passes, when they DO run, always analyze the whole repo — so their
+    # entries are recomputed regardless of any path scope.
+    ran_passes = {name for name in selected
+                  if name in PER_FILE_PASSES
+                  or (name in PROJECT_PASSES and project_ran)}
+    ran_rules = {rule for name in ran_passes
+                 for rule in RULES_BY_PASS[name]} | {"syntax"}
+    project_ran_rules = {rule for name in ran_passes
+                         if name in PROJECT_PASSES
+                         for rule in RULES_BY_PASS[name]}
+    analyzed = {repo_relpath(p) for p in files} if args.paths else None
+
+    def _rule_of_key(key: str) -> str:
+        parts = key.split(":")
+        return parts[1] if len(parts) >= 3 else ""
+
+    def _recomputed(key: str) -> bool:
+        """Was this baseline entry's finding recomputed by THIS run?  An
+        entry whose rule no longer exists belongs to no pass and counts
+        as recomputed: the rewrite is the prune path for dead-rule
+        garbage, and the stale report must keep naming it."""
+        rule = _rule_of_key(key)
+        if rule not in ALL_RULES:
+            return True
+        if rule not in ran_rules:
+            return False
+        if rule in project_ran_rules:
+            return True  # whole-repo pass: path scope does not shield it
+        return analyzed is None or key.split(":", 1)[0] in analyzed
+
     if args.write_baseline:
-        # a path-scoped rewrite must not drop entries for files it never
-        # analyzed — only the analyzed files' keys are regenerated
-        keep = {}
-        if args.paths:
-            analyzed = {repo_relpath(p) for p in files}
-            keep = {k: v for k, v in previous.items()
-                    if k.split(":", 1)[0] not in analyzed}
+        # a rewrite regenerates only recomputed keys; everything else is
+        # kept verbatim (files a path-scoped run never analyzed, passes
+        # that never ran)
+        keep = {k: v for k, v in previous.items() if not _recomputed(k)}
         baseline_mod.save(args.baseline, findings, previous, keep=keep)
         print(f"dmlclint: baseline written to {args.baseline} "
               f"({len(findings)} finding(s), {len(keep)} out-of-scope "
@@ -397,25 +733,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     new, baselined, stale = baseline_mod.partition(findings, previous)
-    if args.paths:
-        # a scoped run never recomputed out-of-scope files: their baseline
-        # entries are not "fixed or moved", so don't advise pruning them
-        analyzed = {repo_relpath(p) for p in files}
-        stale = [k for k in stale if k.split(":", 1)[0] in analyzed]
-    for f in new:
-        print(f.render())
-    if args.verbose:
-        counts: Dict[str, int] = {}
-        for f in baselined:
-            key = baseline_mod._instance_key(f.key, counts)
-            note = previous.get(key, previous.get(f.key, ""))
-            print(f"{f.render()}  (baselined: {note})")
+    # a non-recomputed entry is not "fixed or moved" — don't advise
+    # pruning it; recomputed ones (incl. dead-rule garbage) stay reported
+    stale = [k for k in stale if _recomputed(k)]
+
+    summary = (f"dmlclint: {len(files)} files, {len(new)} new finding(s), "
+               f"{len(baselined)} baselined, {len(stale)} stale")
+    if args.output:
+        # the SARIF artifact is writable from ANY format mode, so one gate
+        # run can render annotations AND produce the machine-readable
+        # record (the CI analysis job relies on this)
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(json.dumps(_sarif_document(new), indent=2) + "\n")
+        print(f"dmlclint: SARIF written to {args.output}")
+    if args.fmt == "sarif":
+        if not args.output:
+            # the document owns stdout; keep it parseable
+            print(json.dumps(_sarif_document(new), indent=2))
+            print(summary, file=sys.stderr)
+    else:
+        for f in new:
+            if args.fmt == "github":
+                print(_github_annotation(f))
+            print(f.render())
+        if args.verbose:
+            counts: Dict[str, int] = {}
+            for f in baselined:
+                key = baseline_mod._instance_key(f.key, counts)
+                note = previous.get(key, previous.get(f.key, ""))
+                print(f"{f.render()}  (baselined: {note})")
     if stale:
         print(f"dmlclint: {len(stale)} stale baseline entr"
               f"{'y' if len(stale) == 1 else 'ies'} (fixed or moved — prune "
               f"with --write-baseline):", file=sys.stderr)
         for key in stale:
             print(f"  {key}", file=sys.stderr)
-    print(f"dmlclint: {len(files)} files, {len(new)} new finding(s), "
-          f"{len(baselined)} baselined, {len(stale)} stale")
+    if args.fmt != "sarif" or args.output:
+        print(summary)
     return 1 if new else 0

@@ -26,6 +26,12 @@ Three rules over every class/function in a library module:
 Lexical lock tracking is deliberately unsound in both directions (a method
 may be single-threaded by protocol; a lock can be taken by a caller) — the
 baseline/suppression machinery exists precisely to record those verdicts.
+
+This pass stays per-file by design; the *cross*-module half of threading
+discipline (lock-order cycles, blocking calls made while holding a lock
+through the call graph) lives in :mod:`.deadlock`, which reuses this
+module's :data:`LOCK_TYPES` as the single definition of what constructs a
+lock.
 """
 
 from __future__ import annotations

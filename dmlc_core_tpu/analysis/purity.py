@@ -48,6 +48,7 @@ import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from dmlc_core_tpu.analysis.driver import FileContext, Finding, dotted_name
+from dmlc_core_tpu.analysis.graph import resolve_callable as _resolve_callable
 
 __all__ = ["run", "TRACE_WRAPPERS"]
 
@@ -108,35 +109,10 @@ def _wrapper_name(expr: ast.AST) -> Optional[str]:
     return short if short in TRACE_DECORATORS else None
 
 
-def _resolve_callable(ctx: FileContext, expr: ast.AST,
-                      defs: Dict[str, List[_FuncNode]],
-                      aliases: Dict[str, ast.AST],
-                      hops: int = 0) -> List[_FuncNode]:
-    """Function defs / lambda nodes an expression may refer to."""
-    if hops > 4 or expr is None:
-        return []
-    if isinstance(expr, ast.Lambda):
-        return [expr]
-    if isinstance(expr, ast.Call):  # functools.partial(f, ...) inline
-        fname = dotted_name(expr.func) or ""
-        if fname.rsplit(".", 1)[-1] == "partial" and expr.args:
-            return _resolve_callable(ctx, expr.args[0], defs, aliases,
-                                     hops + 1)
-        return []
-    name = dotted_name(expr)
-    if name is None:
-        return []
-    short = name.rsplit(".", 1)[-1]
-    if isinstance(expr, ast.Name):
-        alias = aliases.get(short)
-        if alias is not None and alias is not expr:
-            resolved = _resolve_callable(ctx, alias, defs, aliases, hops + 1)
-            if resolved:
-                return resolved
-        return defs.get(short, [])
-    if name.startswith(("self.", "cls.")):
-        return defs.get(short, [])
-    return []
+# module-local callable resolution is shared project infrastructure now:
+# :func:`dmlc_core_tpu.analysis.graph.resolve_callable` (hoisted from here
+# so the interprocedural passes and this one can never diverge on what an
+# expression calls)
 
 
 def _trace_roots(ctx: FileContext) -> List[_FuncNode]:

@@ -307,6 +307,13 @@ _pool_lock = threading.Lock()
 _shared_pool: Optional[ProcessPoolExecutor] = None
 _shared_size = 0
 
+# bound on the bring-up warmup probe below: it runs under _pool_lock, so a
+# wedged spawn (e.g. an inherited-state deadlock in a worker) must surface
+# as a loud bring-up failure — which the caller already handles by falling
+# back to the thread path — instead of parking every parser thread on the
+# lock forever (dmlclint deadlock-blocking-under-lock)
+_WARMUP_TIMEOUT_S = 120.0
+
 
 def _get_shared_pool(nproc: int) -> Tuple[ProcessPoolExecutor, int]:
     global _shared_pool, _shared_size
@@ -322,7 +329,7 @@ def _get_shared_pool(nproc: int) -> Tuple[ProcessPoolExecutor, int]:
             # a BrokenProcessPool mid-parse — and forces worker spawn so
             # the first chunk doesn't pay it
             try:
-                pool.submit(_worker_ready).result()
+                pool.submit(_worker_ready).result(_WARMUP_TIMEOUT_S)
             except BaseException:
                 # a failed bring-up must not leak the executor's queue/
                 # threads/half-spawned workers on every retrying parser

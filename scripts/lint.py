@@ -27,7 +27,8 @@ def _run_pyflakes(paths) -> int:
         from pyflakes import api as pyflakes_api
         from pyflakes.reporter import Reporter
     except ImportError:
-        print("pyflakes not installed; dmlclint only")
+        # stderr: `--format sarif` owns stdout with the JSON document
+        print("pyflakes not installed; dmlclint only", file=sys.stderr)
         return 0
 
     class Counter:
@@ -58,9 +59,11 @@ def main() -> int:
     # dmlclint_main already parsed argv successfully, so re-parsing with
     # the SAME parser (abbreviations and all) cannot fail or diverge
     args = build_parser().parse_args(argv)
-    if args.write_baseline or args.list_rules:
+    if args.write_baseline or args.list_rules or args.emit_knob_catalog \
+            or args.emit_span_catalog:
         # mode flags, not a gate run: a pyflakes message must not flip a
-        # successful baseline write / rule listing into a failure
+        # successful baseline write / rule listing / catalog emission into
+        # a failure
         return status
     if _run_pyflakes(args.paths):
         status = 1

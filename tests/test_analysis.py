@@ -987,3 +987,1072 @@ def test_real_parse_proc_module_is_clean():
     with open(path, encoding="utf-8") as f:
         found = [x.rule for x in analyze_source(f.read(), SHM_PATH)]
     assert "shm-no-pickle" not in found
+
+
+# -- graph core (shared module/call-graph infrastructure) ---------------------
+
+import ast  # noqa: E402
+
+
+def _ctx(relpath, src):
+    from dmlc_core_tpu.analysis.driver import FileContext
+
+    src = textwrap.dedent(src)
+    return FileContext(relpath, src, ast.parse(src), True, False)
+
+
+def _graph(files):
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    return ProjectGraph(_ctx(rel, src) for rel, src in files.items())
+
+
+def _fn(graph, modname, qualname):
+    mod = graph.modules[modname]
+    if "." in qualname:
+        cls, meth = qualname.split(".")
+        return mod.classes[cls].methods[meth]
+    return mod.top_defs[qualname]
+
+
+def test_graph_module_names():
+    from dmlc_core_tpu.analysis.graph import module_name_of
+
+    assert module_name_of("dmlc_core_tpu/io/stream.py") == \
+        "dmlc_core_tpu.io.stream"
+    assert module_name_of("dmlc_core_tpu/fault/__init__.py") == \
+        "dmlc_core_tpu.fault"
+    assert module_name_of("bench.py") == "bench"
+
+
+def test_graph_cross_module_call_edges():
+    g = _graph({
+        "dmlc_core_tpu/a.py": """
+            from dmlc_core_tpu.b import helper
+
+            def caller():
+                return helper()
+        """,
+        "dmlc_core_tpu/b.py": """
+            def helper():
+                return 1
+        """,
+    })
+    caller = _fn(g, "dmlc_core_tpu.a", "caller")
+    callees = [callee.fq for _, callee in g.callees(caller)]
+    assert callees == ["dmlc_core_tpu.b:helper"]
+
+
+def test_graph_module_attribute_and_relative_imports():
+    g = _graph({
+        "dmlc_core_tpu/pkg/__init__.py": "",
+        "dmlc_core_tpu/pkg/a.py": """
+            from dmlc_core_tpu.pkg import b
+            from . import c
+
+            def via_attr():
+                b.f()
+
+            def via_relative():
+                c.g()
+        """,
+        "dmlc_core_tpu/pkg/b.py": "def f():\n    pass\n",
+        "dmlc_core_tpu/pkg/c.py": "def g():\n    pass\n",
+    })
+    attr = _fn(g, "dmlc_core_tpu.pkg.a", "via_attr")
+    rel = _fn(g, "dmlc_core_tpu.pkg.a", "via_relative")
+    assert [c.fq for _, c in g.callees(attr)] == ["dmlc_core_tpu.pkg.b:f"]
+    assert [c.fq for _, c in g.callees(rel)] == ["dmlc_core_tpu.pkg.c:g"]
+
+
+def test_graph_alias_and_partial_resolution():
+    # name = functools.partial(f, ...) then alias() resolves to f — the
+    # resolver hoisted out of purity.py, now shared by every pass
+    g = _graph({
+        "dmlc_core_tpu/a.py": """
+            import functools
+
+            def real(n, x):
+                return x
+
+            wrapped = functools.partial(real, 4)
+
+            def launch():
+                return wrapped()
+        """,
+    })
+    launch = _fn(g, "dmlc_core_tpu.a", "launch")
+    assert [c.qualname for _, c in g.callees(launch)] == ["real"]
+
+
+def test_graph_self_attr_type_inference():
+    # self.admission = AdmissionController() in __init__ makes
+    # self.admission.release() resolve to AdmissionController.release
+    g = _graph({
+        "dmlc_core_tpu/x.py": """
+            from dmlc_core_tpu.y import Gate
+
+            class Owner:
+                def __init__(self, gate=None):
+                    self.gate = gate or Gate()
+
+                def work(self):
+                    self.gate.release()
+        """,
+        "dmlc_core_tpu/y.py": """
+            class Gate:
+                def release(self):
+                    pass
+        """,
+    })
+    work = _fn(g, "dmlc_core_tpu.x", "Owner.work")
+    assert [c.fq for _, c in g.callees(work)] == \
+        ["dmlc_core_tpu.y:Gate.release"]
+
+
+def test_graph_param_annotation_resolution():
+    g = _graph({
+        "dmlc_core_tpu/x.py": """
+            from dmlc_core_tpu.y import Gate
+
+            def drive(gate: "Gate"):
+                gate.release()
+        """,
+        "dmlc_core_tpu/y.py": """
+            class Gate:
+                def release(self):
+                    pass
+        """,
+    })
+    drive = _fn(g, "dmlc_core_tpu.x", "drive")
+    assert [c.fq for _, c in g.callees(drive)] == \
+        ["dmlc_core_tpu.y:Gate.release"]
+
+
+def test_purity_still_uses_shared_resolver():
+    # the hoist must not regress the purity pass's partial/alias roots
+    rules = rules_of("""
+        import jax
+        from functools import partial
+
+        def _kernel(n, x):
+            return float(x)
+
+        kernel = partial(_kernel, 4)
+
+        def launch(x):
+            return jax.jit(kernel)(x)
+    """)
+    assert rules == ["purity-host-sync"]
+
+
+# -- pass 6: deadlock ---------------------------------------------------------
+
+def _project_findings(files):
+    from dmlc_core_tpu.analysis import contracts, deadlock
+
+    g = _graph(files)
+    return deadlock.run_project(g)
+
+
+THREE_LOCK_CYCLE = {
+    "dmlc_core_tpu/la.py": """
+        import threading
+        from dmlc_core_tpu import lb
+
+        class A:
+            def __init__(self):
+                self._la = threading.Lock()
+                self.bee = lb.B()
+
+            def one(self):
+                with self._la:
+                    self.bee.two()
+    """,
+    "dmlc_core_tpu/lb.py": """
+        import threading
+        from dmlc_core_tpu import lc
+
+        class B:
+            def __init__(self):
+                self._lb = threading.Lock()
+                self.cee = lc.C()
+
+            def two(self):
+                with self._lb:
+                    self.cee.three()
+    """,
+    "dmlc_core_tpu/lc.py": """
+        import threading
+        from dmlc_core_tpu.la import A
+
+        class C:
+            def __init__(self):
+                self._lc = threading.Lock()
+
+            def three(self):
+                with self._lc:
+                    pass
+
+            def loop(self, a: "A"):
+                with self._lc:
+                    a.one()
+    """,
+}
+
+
+def test_deadlock_three_lock_cross_module_cycle():
+    found = _project_findings(THREE_LOCK_CYCLE)
+    cycles = [f for f in found if f.rule == "deadlock-lock-cycle"]
+    assert len(cycles) == 1
+    [f] = cycles
+    # the canonical cycle names all three locks and the witness edges
+    assert "A._la" in f.symbol and "B._lb" in f.symbol \
+        and "C._lc" in f.symbol
+    assert "opposite order" in f.message
+
+
+def test_deadlock_cycle_clean_twin_consistent_order():
+    # same three locks, acquired in one global order everywhere: no cycle
+    clean = dict(THREE_LOCK_CYCLE)
+    clean["dmlc_core_tpu/lc.py"] = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lc = threading.Lock()
+
+            def three(self):
+                with self._lc:
+                    pass
+    """
+    assert _project_findings(clean) == []
+
+
+def test_deadlock_nonreentrant_self_reacquire_trips():
+    found = _project_findings({
+        "dmlc_core_tpu/m.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+        """,
+    })
+    assert [f.rule for f in found] == ["deadlock-lock-cycle"]
+    assert "unconditionally" in found[0].message
+
+
+def test_deadlock_rlock_reentry_is_clean():
+    # the MicroBatcher idiom: an RLock re-acquired through a helper
+    assert _project_findings({
+        "dmlc_core_tpu/m.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+        """,
+    }) == []
+
+
+BLOCKING = {
+    "dmlc_core_tpu/m.py": """
+        import queue
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad(self):
+                with self._lock:
+                    return self._q.get()
+    """,
+}
+
+
+def test_deadlock_blocking_under_lock_trips():
+    found = _project_findings(BLOCKING)
+    assert [f.rule for f in found] == ["deadlock-blocking-under-lock"]
+    assert found[0].symbol == "W.bad"
+    assert "_q.get()" in found[0].message
+
+
+def test_deadlock_blocking_clean_twins():
+    # timeout-bounded / outside-the-lock variants must not trip
+    for body in (
+        "with self._lock:\n                    pass\n"
+        "                return self._q.get()",
+        "with self._lock:\n"
+        "                    return self._q.get(timeout=1.0)",
+        "with self._lock:\n"
+        "                    return self._q.get_nowait()",
+    ):
+        files = {"dmlc_core_tpu/m.py": BLOCKING["dmlc_core_tpu/m.py"]
+                 .replace("with self._lock:\n"
+                          "                    return self._q.get()", body)}
+        assert _project_findings(files) == [], body
+
+
+def test_deadlock_condition_wait_under_own_lock_is_clean():
+    # `with self._cond: ... self._cond.wait()` is the documented idiom
+    # (wait releases the condition's lock); holding ANOTHER lock across
+    # the wait still trips
+    assert _project_findings({
+        "dmlc_core_tpu/m.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def pop(self):
+                    with self._cond:
+                        while self.empty():
+                            self._cond.wait()
+
+                def empty(self):
+                    return True
+        """,
+    }) == []
+    found = _project_findings({
+        "dmlc_core_tpu/m.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._other = threading.Lock()
+
+                def pop(self):
+                    with self._other:
+                        with self._cond:
+                            self._cond.wait()
+        """,
+    })
+    assert [f.rule for f in found] == ["deadlock-blocking-under-lock"]
+    assert "releases only" in found[0].message
+
+
+def test_deadlock_blocking_through_call_graph():
+    # holding a lock and calling a helper that joins a thread: the wait is
+    # one hop away but the lock is held across it all the same
+    found = _project_findings({
+        "dmlc_core_tpu/m.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=print, daemon=True)
+
+                def stop(self):
+                    with self._lock:
+                        self._halt()
+
+                def _halt(self):
+                    self._t.join()
+        """,
+    })
+    rules = [f.rule for f in found]
+    assert "deadlock-blocking-under-lock" in rules
+    [f] = [f for f in found if f.rule == "deadlock-blocking-under-lock"]
+    assert f.symbol == "S.stop" and "_halt" in f.message
+
+
+def test_deadlock_module_level_lock_cross_module():
+    # the parse_proc shape: module-global lock + .result() under it
+    found = _project_findings({
+        "dmlc_core_tpu/pool.py": """
+            import threading
+
+            _pool_lock = threading.Lock()
+
+            def warm(pool):
+                with _pool_lock:
+                    pool.submit(print).result()
+        """,
+    })
+    assert [f.rule for f in found] == ["deadlock-blocking-under-lock"]
+    assert "_pool_lock" in found[0].message
+    # the committed fix's shape — a positional timeout — is clean
+    assert _project_findings({
+        "dmlc_core_tpu/pool.py": """
+            import threading
+
+            _pool_lock = threading.Lock()
+
+            def warm(pool):
+                with _pool_lock:
+                    pool.submit(print).result(120.0)
+        """,
+    }) == []
+
+
+def test_deadlock_suppression_via_driver(tmp_path):
+    """Project-pass findings honor `# dmlclint: disable=` in the anchoring
+    file, end to end through the CLI (--pass deadlock on a scoped repo)."""
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    src = textwrap.dedent("""
+        import queue
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad(self):
+                with self._lock:
+                    # protocol: single-threaded during bring-up
+                    # dmlclint: disable=deadlock-blocking-under-lock
+                    return self._q.get()
+    """)
+    (pkg / "w.py").write_text(src)
+    from dmlc_core_tpu.analysis import deadlock
+    from dmlc_core_tpu.analysis.driver import (FileContext,
+                                               suppressed_lines)
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    ctx = FileContext("dmlc_core_tpu/w.py", src, ast.parse(src), True, False)
+    findings = deadlock.run_project(ProjectGraph([ctx]))
+    assert [f.rule for f in findings] == ["deadlock-blocking-under-lock"]
+    supp = suppressed_lines(src)
+    assert {"deadlock-blocking-under-lock"} <= supp.get(findings[0].lineno,
+                                                        set())
+
+
+# -- pass 7: contracts --------------------------------------------------------
+
+def _contract_findings(files, docs):
+    from dmlc_core_tpu.analysis import contracts
+
+    g = _graph(files)
+    return contracts.run_project(g, {k: textwrap.dedent(v)
+                                     for k, v in docs.items()})
+
+
+CODE_WITH_KNOB = {
+    "dmlc_core_tpu/k.py": """
+        import os
+
+        def knob():
+            return os.environ.get("DMLC_SHINY_NEW", "")
+    """,
+}
+
+KNOB_DOC = {"docs/robustness.md": """
+    | variable | default | meaning |
+    |---|---|---|
+    | `DMLC_SHINY_NEW` | unset | the new knob |
+"""}
+
+
+def test_contract_undocumented_knob_trips_and_doc_row_clears():
+    found = _contract_findings(CODE_WITH_KNOB, {"docs/robustness.md": ""})
+    assert [f.rule for f in found] == ["contract-undocumented-knob"]
+    assert found[0].symbol == "DMLC_SHINY_NEW"
+    assert found[0].path == "dmlc_core_tpu/k.py"
+    assert _contract_findings(CODE_WITH_KNOB, KNOB_DOC) == []
+
+
+def test_contract_knob_read_through_constant_and_get_env():
+    # ENV_X = "DMLC_X"; os.environ.get(ENV_X) and param.get_env("DMLC_Y")
+    # are both static reads and must count
+    files = {
+        "dmlc_core_tpu/k.py": """
+            import os
+            from dmlc_core_tpu.param import get_env
+
+            ENV_X = "DMLC_VIA_CONST"
+
+            def a():
+                return os.environ.get(ENV_X)
+
+            def b():
+                return get_env("DMLC_VIA_HELPER", float, 0.0)
+        """,
+        "dmlc_core_tpu/param.py": """
+            def get_env(key, dtype, default):
+                return default
+        """,
+    }
+    found = _contract_findings(files, {"docs/robustness.md": ""})
+    assert sorted(f.symbol for f in found) == \
+        ["DMLC_VIA_CONST", "DMLC_VIA_HELPER"]
+
+
+def test_contract_stale_doc_knob_entry_trips():
+    found = _contract_findings(
+        {"dmlc_core_tpu/k.py": "def nothing():\n    pass\n"}, KNOB_DOC)
+    assert [f.rule for f in found] == ["contract-stale-doc-entry"]
+    assert found[0].symbol == "knob:DMLC_SHINY_NEW"
+    assert found[0].path == "docs/robustness.md"
+
+
+def test_contract_metric_both_directions():
+    code = {
+        "dmlc_core_tpu/m.py": """
+            from dmlc_core_tpu import telemetry
+
+            def meter(n):
+                telemetry.count("dmlc_widgets_total", n)
+        """,
+    }
+    doc_ok = {"docs/observability.md": """
+        | Name | Kind | Labels | Meaning |
+        | --- | --- | --- | --- |
+        | `dmlc_widgets_total` | counter | — | widgets |
+    """}
+    doc_stale = {"docs/observability.md": """
+        | Name | Kind | Labels | Meaning |
+        | --- | --- | --- | --- |
+        | `dmlc_gone_total` | counter | — | removed long ago |
+    """}
+    found = _contract_findings(code, {"docs/observability.md": ""})
+    assert [f.rule for f in found] == ["contract-undocumented-metric"]
+    assert _contract_findings(code, doc_ok) == []
+    found = _contract_findings(code, doc_stale)
+    assert sorted(f.rule for f in found) == \
+        ["contract-stale-doc-entry", "contract-undocumented-metric"]
+
+
+def test_contract_span_catalog_and_wildcards():
+    code = {
+        "dmlc_core_tpu/s.py": """
+            from dmlc_core_tpu import telemetry
+
+            def a():
+                with telemetry.span("widget.assemble"):
+                    pass
+
+            def b(op):
+                with telemetry.span(f"collective.{op}"):
+                    pass
+        """,
+    }
+    # span tables are typed by their header's first cell; a wildcard row
+    # satisfies the dynamic name family and is exempt from stale checks
+    doc = {"docs/observability.md": """
+        | span | recorded at |
+        | --- | --- |
+        | `widget.assemble` | `dmlc_core_tpu/s.py` |
+        | `collective.<op>` | `dmlc_core_tpu/s.py` |
+    """}
+    assert _contract_findings(code, doc) == []
+    found = _contract_findings(code, {"docs/observability.md": ""})
+    assert [f.rule for f in found] == ["contract-undocumented-span"]
+    assert found[0].symbol == "widget.assemble"  # the f-string is invisible
+
+
+def test_contract_span_outside_span_table_does_not_document():
+    # a span-shaped token in a non-span table (e.g. the fault-site table)
+    # must not satisfy the span contract
+    code = {
+        "dmlc_core_tpu/s.py": """
+            from dmlc_core_tpu import telemetry
+
+            def a():
+                with telemetry.span("widget.assemble"):
+                    pass
+        """,
+    }
+    doc = {"docs/robustness.md": """
+        | site | where | kinds |
+        |---|---|---|
+        | `widget.assemble` | somewhere | act kinds |
+    """}
+    found = _contract_findings(code, doc)
+    assert "contract-undocumented-span" in [f.rule for f in found]
+
+
+FAULT_INIT = """
+    SITES = {
+        "tracker.accept": "the accept loop",
+        "data.parse_worker": "per worker sub-range",
+    }
+
+    def inject(site, **ctx):
+        pass
+"""
+
+
+def test_contract_site_registry_vs_docs_and_uses():
+    files = {
+        "dmlc_core_tpu/fault/__init__.py": FAULT_INIT,
+        "dmlc_core_tpu/user.py": """
+            from dmlc_core_tpu import fault
+
+            def work():
+                fault.inject("tracker.accept")
+                fault.inject("rogue.site")
+        """,
+    }
+    doc = {"docs/robustness.md": """
+        | site | where | meaningful kinds |
+        |---|---|---|
+        | `tracker.accept` | accept loop | act kinds |
+        | `data.parse_worker` | parse worker | exit |
+    """}
+    found = _contract_findings(files, doc)
+    # rogue.site is injected but unregistered; everything else is clean
+    assert [(f.rule, f.symbol) for f in found] == \
+        [("contract-undocumented-site", "rogue.site")]
+    # drop the doc row for data.parse_worker: registered-but-undocumented
+    doc_missing = {"docs/robustness.md": """
+        | site | where | meaningful kinds |
+        |---|---|---|
+        | `tracker.accept` | accept loop | act kinds |
+    """}
+    found = _contract_findings(files, doc_missing)
+    assert ("contract-undocumented-site", "data.parse_worker") in \
+        [(f.rule, f.symbol) for f in found]
+    # a doc row for a site the registry lost is stale
+    doc_extra = {"docs/robustness.md": """
+        | site | where | meaningful kinds |
+        |---|---|---|
+        | `tracker.accept` | accept loop | act kinds |
+        | `data.parse_worker` | parse worker | exit |
+        | `ghost.site` | nowhere | — |
+    """}
+    found = _contract_findings(files, doc_extra)
+    assert [(f.rule, f.symbol) for f in found] == \
+        [("contract-undocumented-site", "rogue.site"),
+         ("contract-stale-doc-entry", "site:ghost.site")]
+
+
+def test_contract_doc_markup_forms_still_document():
+    # `DMLC_X=1` / `dmlc_y_total{a,b}` table cells document the bare name
+    code = {
+        "dmlc_core_tpu/k.py": """
+            import os
+            from dmlc_core_tpu import telemetry
+
+            def a():
+                os.environ.get("DMLC_SWITCH")
+                telemetry.count("dmlc_hits_total", 1, site="x")
+        """,
+    }
+    doc = {"docs/observability.md": """
+        | Env var | Effect |
+        | --- | --- |
+        | `DMLC_SWITCH=1` | turn it on |
+
+        | Name | Kind |
+        | --- | --- |
+        | `dmlc_hits_total{site}` | counter |
+    """}
+    assert _contract_findings(code, doc) == []
+
+
+def test_contract_catalog_renderers():
+    from dmlc_core_tpu.analysis import contracts
+
+    g = _graph(CODE_WITH_KNOB)
+    knobs = contracts.render_knob_catalog(g)
+    assert "| `DMLC_SHINY_NEW` | `dmlc_core_tpu/k.py` |" in knobs
+    g = _graph({
+        "dmlc_core_tpu/s.py": """
+            from dmlc_core_tpu import telemetry
+
+            def a():
+                with telemetry.span("widget.assemble"):
+                    pass
+        """,
+    })
+    spans = contracts.render_span_catalog(g)
+    assert "| `widget.assemble` | `dmlc_core_tpu/s.py` |" in spans
+
+
+def test_committed_catalogs_match_code():
+    """The generated doc catalogs must exactly reproduce from the code —
+    the freshness contract the CI gate enforces via the contract rules."""
+    from dmlc_core_tpu.analysis import contracts
+    from dmlc_core_tpu.analysis.driver import _project_contexts
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    g = ProjectGraph(_project_contexts())
+    with open(os.path.join(REPO, "docs", "robustness.md"),
+              encoding="utf-8") as f:
+        robustness = f.read()
+    for line in contracts.render_knob_catalog(g).splitlines():
+        assert line in robustness, f"knob catalog drifted: {line}"
+    with open(os.path.join(REPO, "docs", "observability.md"),
+              encoding="utf-8") as f:
+        observability = f.read()
+    for line in contracts.render_span_catalog(g).splitlines():
+        assert line in observability, f"span catalog drifted: {line}"
+
+
+# -- driver: --pass / --format / project-pass wiring --------------------------
+
+def test_cli_pass_selection_contracts_standalone():
+    """`--pass contracts` runs repo-wide even though fast, and exits 0 on
+    the committed tree (the CI doc-drift step)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.analysis",
+         "--pass", "contracts"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_unknown_pass_is_usage_error(capsys):
+    assert main(["--pass", "nonsense"]) == 2
+    assert "unknown pass" in capsys.readouterr().err
+
+
+def test_cli_list_rules_has_new_passes(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("deadlock-lock-cycle", "deadlock-blocking-under-lock",
+                 "contract-undocumented-knob",
+                 "contract-undocumented-metric",
+                 "contract-undocumented-span",
+                 "contract-undocumented-site",
+                 "contract-stale-doc-entry"):
+        assert rule in out
+
+
+def test_cli_scoped_run_skips_project_passes(tmp_path, capsys):
+    """A path-scoped run (the editor/per-file workflow) must not pay for —
+    or report — whole-repo passes unless --pass asks for them."""
+    pkg = _write_pkg(tmp_path, "print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    assert main([pkg, "--baseline", bl]) == 1
+    out = capsys.readouterr().out
+    assert "style-no-print" in out
+    assert "contract-" not in out and "deadlock-" not in out
+
+
+def test_cli_format_github_annotations(tmp_path, capsys):
+    pkg = _write_pkg(tmp_path, "print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    assert main([pkg, "--baseline", bl, "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    assert "::error file=" in out and "style-no-print" in out
+    line = [l for l in out.splitlines() if l.startswith("::error")][0]
+    assert "line=1" in line and "title=dmlclint style-no-print" in line
+
+
+def test_cli_format_sarif(tmp_path, capsys):
+    pkg = _write_pkg(tmp_path, "print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    assert main([pkg, "--baseline", bl, "--format", "sarif"]) == 1
+    out = capsys.readouterr().out
+    doc = json.loads(out)  # stdout is the parseable document
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1
+    assert results[0]["ruleId"] == "style-no-print"
+    assert results[0]["locations"][0]["physicalLocation"][
+        "artifactLocation"]["uri"].endswith("victim.py")
+    rules = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert "deadlock-lock-cycle" in rules
+
+
+def test_cli_format_sarif_output_file(tmp_path, capsys):
+    pkg = _write_pkg(tmp_path, "print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    out_file = str(tmp_path / "findings.sarif")
+    assert main([pkg, "--baseline", bl, "--format", "sarif",
+                 "--output", out_file]) == 1
+    capsys.readouterr()
+    with open(out_file, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert [r["ruleId"] for r in doc["runs"][0]["results"]] == \
+        ["style-no-print"]
+
+
+def test_cli_emit_catalogs(capsys):
+    assert main(["--emit-knob-catalog"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("| knob | read at |")
+    assert "`DMLC_FAULT_PLAN`" in out
+    assert main(["--emit-span-catalog"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("| span | recorded at |")
+    assert "`serve.request`" in out
+
+
+def test_real_parse_proc_warmup_is_deadlock_clean():
+    """Regression for the finding this pass surfaced at introduction: the
+    shared-pool warmup probe blocked on .result() with no timeout while
+    holding _pool_lock — a wedged spawn would have parked every parser
+    thread on the lock forever.  The probe is now time-bounded."""
+    from dmlc_core_tpu.analysis import deadlock
+    from dmlc_core_tpu.analysis.driver import FileContext
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    path = os.path.join(REPO, "dmlc_core_tpu", "data", "parse_proc.py")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    ctx = FileContext("dmlc_core_tpu/data/parse_proc.py", src,
+                      ast.parse(src), True, False)
+    found = deadlock.run_project(ProjectGraph([ctx]))
+    assert [f for f in found if f.symbol == "_get_shared_pool"] == []
+
+
+def test_project_scope_includes_bench_and_loadgen():
+    """The scope-extension contract: bench.py (EXTRA_DEEP) and
+    serve/loadgen.py ride in the project graph, so the deadlock pass sees
+    their locks/threads interacting with the rest of the repo."""
+    from dmlc_core_tpu.analysis.driver import _project_contexts
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    g = ProjectGraph(_project_contexts())
+    assert "bench" in g.modules
+    assert "dmlc_core_tpu.serve.loadgen" in g.modules
+    # and the scheduler/admission/flight lock-heavy modules are all there
+    for mod in ("dmlc_core_tpu.serve.scheduler",
+                "dmlc_core_tpu.serve.admission",
+                "dmlc_core_tpu.telemetry.flight",
+                "dmlc_core_tpu.data.parse_proc",
+                "dmlc_core_tpu.io.threadediter"):
+        assert mod in g.modules, mod
+
+
+# -- review-hardening regressions ---------------------------------------------
+
+def test_scoped_write_baseline_keeps_project_pass_entries(tmp_path, capsys):
+    """Regression: a path-scoped `--write-baseline` (which skips project
+    passes) used to drop deadlock/contract baseline entries for the
+    analyzed files — the next full run then failed on 'new' findings the
+    team had already triaged.  Entries for passes that did not run are
+    kept verbatim; the scoped non-write run must not report them stale
+    either."""
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    (pkg / "victim.py").write_text("print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    project_key = ("dmlc_core_tpu/victim.py:deadlock-blocking-under-lock:"
+                   "W.bad")
+    baseline_mod.save(bl, [], {},
+                      keep={project_key: "two instances; cannot wedge"})
+    # seed the per-file finding into the baseline, scoped
+    assert main([str(pkg), "--baseline", bl, "--write-baseline"]) == 0
+    kept = baseline_mod.load(bl)
+    assert project_key in kept, "project-pass entry dropped by scoped rewrite"
+    assert kept[project_key] == "two instances; cannot wedge"
+    # and the scoped gate run neither fails nor calls it stale
+    assert main([str(pkg), "--baseline", bl]) == 0
+    captured = capsys.readouterr()
+    assert "deadlock" not in captured.err
+    assert "0 stale" in captured.out
+
+
+def test_contract_dotless_span_is_documentable():
+    """Regression: code-side span extraction accepts any literal, but the
+    doc-side match required a dot — `telemetry.span("startup")` could
+    never be cleared by any catalog row."""
+    code = {
+        "dmlc_core_tpu/s.py": """
+            from dmlc_core_tpu import telemetry
+
+            def a():
+                with telemetry.span("startup"):
+                    pass
+        """,
+    }
+    found = _contract_findings(code, {"docs/observability.md": ""})
+    assert [f.symbol for f in found] == ["startup"]
+    doc = {"docs/observability.md": """
+        | span | recorded at |
+        | --- | --- |
+        | `startup` | `dmlc_core_tpu/s.py` |
+    """}
+    assert _contract_findings(code, doc) == []
+
+
+def test_cli_output_writes_sarif_under_github_format(tmp_path, capsys):
+    """Regression: the CI gate runs ONCE with `--format github --output
+    dmlclint.sarif` — the SARIF artifact must be written from any format
+    mode, not only --format sarif."""
+    pkg = _write_pkg(tmp_path, "print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    out_file = str(tmp_path / "findings.sarif")
+    assert main([pkg, "--baseline", bl, "--format", "github",
+                 "--output", out_file]) == 1
+    out = capsys.readouterr().out
+    assert "::error file=" in out  # annotations still rendered
+    with open(out_file, encoding="utf-8") as f:
+        doc = json.load(f)
+    assert [r["ruleId"] for r in doc["runs"][0]["results"]] == \
+        ["style-no-print"]
+
+
+def test_deadlock_semaphore_self_reacquire_not_unconditional():
+    """Regression: a counting Semaphore acquired twice on one thread is
+    legal while the count allows — it must not be reported as an
+    unconditional single-lock deadlock (the initial value is invisible
+    statically).  Cycles between DISTINCT semaphores still flag."""
+    assert _project_findings({
+        "dmlc_core_tpu/m.py": """
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._slots = threading.Semaphore(4)
+
+                def outer(self):
+                    with self._slots:
+                        self.inner()
+
+                def inner(self):
+                    with self._slots:
+                        pass
+        """,
+    }) == []
+
+
+def test_deadlock_multi_item_with_orders_items():
+    """Regression: `with a, b:` acquires left-to-right exactly like the
+    nested form — opposite item orders in two functions are a two-lock
+    inversion and must produce a cycle finding."""
+    found = _project_findings({
+        "dmlc_core_tpu/m.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lb = threading.Lock()
+
+                def f(self):
+                    with self._la, self._lb:
+                        pass
+
+                def g(self):
+                    with self._lb, self._la:
+                        pass
+        """,
+    })
+    assert [f.rule for f in found] == ["deadlock-lock-cycle"]
+    assert "S._la" in found[0].symbol and "S._lb" in found[0].symbol
+
+
+def test_deadlock_propagation_exact_under_mutual_recursion():
+    """Regression: the memoized-DFS propagator cached a PARTIAL result
+    for whichever of two mutually recursive functions was first reached
+    while its partner sat on the recursion stack — so whether a real
+    cycle was reported depended on which caller happened to be scanned
+    first.  The fixpoint propagator is order-independent."""
+    files = {
+        "dmlc_core_tpu/m.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._la = threading.Lock()
+                    self._lc = threading.Lock()
+                    self._lw = threading.Lock()
+
+                def warm(self):
+                    with self._lw:
+                        self.f(0)
+
+                def f(self, n):
+                    with self._la:
+                        pass
+                    if n:
+                        self.g(n - 1)
+
+                def g(self, n):
+                    if n:
+                        self.f(n - 1)
+
+                def closes(self):
+                    with self._lc:
+                        self.g(3)
+
+                def inverts(self):
+                    with self._la:
+                        with self._lc:
+                            pass
+        """,
+    }
+    found = _project_findings(files)
+    assert "deadlock-lock-cycle" in [f.rule for f in found]
+    [f] = [f for f in found if f.rule == "deadlock-lock-cycle"]
+    assert "S._la" in f.symbol and "S._lc" in f.symbol
+    # and the result is identical with the warm() decoy removed
+    files2 = {"dmlc_core_tpu/m.py":
+              files["dmlc_core_tpu/m.py"].replace(
+                  "def warm(self):\n"
+                  "                    with self._lw:\n"
+                  "                        self.f(0)\n", "")}
+    assert [f.rule for f in _project_findings(files2)].count(
+        "deadlock-lock-cycle") == 1
+
+
+def test_write_baseline_prunes_dead_rule_entries(tmp_path, capsys):
+    """Regression: the ran-rules keep filter made baseline entries for
+    renamed/removed rules permanently unprunable and invisible — neither
+    reported stale nor dropped by any rewrite."""
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    (pkg / "victim.py").write_text("print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    dead_key = "dmlc_core_tpu/victim.py:rule-that-was-renamed:f"
+    baseline_mod.save(bl, [], {}, keep={dead_key: "from an older dmlclint"})
+    # the gate run reports it stale (not silently ignored)
+    assert main([str(pkg), "--baseline", bl]) == 1
+    assert dead_key in capsys.readouterr().err
+    # and a rewrite prunes it
+    assert main([str(pkg), "--baseline", bl, "--write-baseline"]) == 0
+    assert dead_key not in baseline_mod.load(bl)
+    capsys.readouterr()
+
+
+def test_scoped_explicit_project_pass_rewrite_prunes_fixed_entries(tmp_path,
+                                                                   capsys):
+    """Regression: a path-scoped `--write-baseline --pass contracts` used
+    to resurrect out-of-scope project-pass entries — but a project pass
+    always analyzes the WHOLE repo, so a fixed finding's entry must be
+    pruned regardless of the path scope."""
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("pass\n")
+    (pkg / "b.py").write_text("pass\n")
+    bl = str(tmp_path / "baseline.json")
+    fixed_key = ("dmlc_core_tpu/serve/scheduler.py:"
+                 "contract-undocumented-knob:DMLC_FAKE_GONE")
+    lockset_key = "dmlc_core_tpu/b.py:lockset-no-join:spawn"
+    baseline_mod.save(bl, [], {}, keep={
+        fixed_key: "was real once", lockset_key: "protocol: owner joins"})
+    # scoped to a.py, contracts explicitly selected: the contracts entry
+    # (whole-repo recomputed, finding gone) is pruned; the lockset entry
+    # for out-of-scope b.py survives
+    assert main([str(pkg / "a.py"), "--baseline", bl,
+                 "--pass", "contracts", "--write-baseline"]) == 0
+    kept = baseline_mod.load(bl)
+    assert fixed_key not in kept
+    assert lockset_key in kept
+    capsys.readouterr()
+
+
+def test_cli_empty_pass_spec_is_usage_error(capsys):
+    """Regression: `--pass ""` (an unset CI shell variable) selected zero
+    passes and exited 0 with every rule disabled."""
+    assert main(["--pass", ""]) == 2
+    assert "names no pass" in capsys.readouterr().err
+    assert main(["--pass", " , "]) == 2
+    capsys.readouterr()
