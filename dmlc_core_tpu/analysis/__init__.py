@@ -21,7 +21,17 @@ that subsystem rebuilt for what *this* codebase actually gets wrong:
 - :mod:`.contracts` — cross-artifact drift: every ``DMLC_*`` knob,
   ``dmlc_*`` metric, span name and fault site in code diffed against the
   docs catalog tables (knob/span catalogs are generated via
-  ``--emit-knob-catalog`` / ``--emit-span-catalog``).
+  ``--emit-knob-catalog`` / ``--emit-span-catalog``; the rule catalog
+  via ``--emit-rule-catalog``).
+- :mod:`.dataflow`  — the statement-level CFG (with exception edges) +
+  forward may-analysis engine under the interprocedural passes.
+- :mod:`.escape`    — exception-path resource escape: acquired shm
+  segments / sockets / executors / mmaps / fds / temp dirs tracked along
+  every path (including raise edges and failed ``__init__``s) with
+  ownership-transfer modeling through the call graph.
+- :mod:`.jaxbound`  — host↔device boundary discipline: transfers outside
+  the ``_accounted_place`` wrapper, float casts re-inflating the narrow
+  wire, and ``jax.jit`` wrappers rebuilt per call.
 - :mod:`.baseline`  — the ratchet: findings are keyed
   ``<file>:<rule>:<symbol>`` against a committed ``analysis_baseline.json``;
   new findings fail, baselined ones are burn-down work.

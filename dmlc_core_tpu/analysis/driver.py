@@ -10,9 +10,10 @@ Two kinds of passes run:
 
 - **per-file passes** (lockset/purity/resources/protocol/transport) see one
   module at a time;
-- **project passes** (deadlock/contracts) see the whole repo at once through
-  the :mod:`.graph` call-graph core — they run on the default (unscoped)
-  gate invocation, or whenever ``--pass`` selects them explicitly.
+- **project passes** (deadlock/contracts/escape/jaxbound) see the whole
+  repo at once through the :mod:`.graph` call-graph core — they run on the
+  default (unscoped) gate invocation, or whenever ``--pass`` selects them
+  explicitly.
 
 ``--format github`` renders new findings as GitHub workflow annotations;
 ``--format sarif`` emits a SARIF 2.1.0 document (``--output`` writes it to
@@ -33,7 +34,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = ["Finding", "FileContext", "analyze_source", "analyze_path",
            "iter_python_files", "main", "ALL_RULES", "ROOT",
-           "PER_FILE_PASSES", "PROJECT_PASSES"]
+           "PER_FILE_PASSES", "PROJECT_PASSES", "render_rule_catalog"]
 
 ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -42,12 +43,12 @@ TARGETS = ["dmlc_core_tpu", "tests", "examples", "bench.py",
            "__graft_entry__.py"]
 
 PER_FILE_PASSES = ("lockset", "purity", "resources", "protocol", "transport")
-PROJECT_PASSES = ("deadlock", "contracts")
+PROJECT_PASSES = ("deadlock", "contracts", "escape", "jaxbound")
 
 # non-library files that still get threading-discipline passes (bench.py
 # spawns watchdog/collector threads; its lock use is production code even
 # though it lives at the repo root) and ride in the project graph for the
-# deadlock/contracts passes
+# whole-repo passes
 EXTRA_DEEP: Dict[str, Tuple[str, ...]] = {"bench.py": ("lockset",)}
 
 # modules whose job is talking to a terminal: exempt from style-no-print
@@ -132,6 +133,30 @@ ALL_RULES = {
     "contract-stale-doc-entry": (
         "a docs catalog row names a knob/metric/span/site the code no "
         "longer has — prune the row or restore the artifact"),
+    "escape-leak-on-raise": (
+        "a path from a resource acquisition (shm/socket/executor/mmap/fd/"
+        "temp dir) to the function exit drops the last reference — "
+        "typically the exception edge between the acquire and the "
+        "finally/with that releases, a failed __init__ orphaning a "
+        "self.-owned handle, or a class that never releases an attr it "
+        "owns"),
+    "escape-double-release": (
+        "a non-idempotent release (unlink/rmtree/os.close) may run twice "
+        "on one path — the second call raises or tears down a reused "
+        "handle"),
+    "jaxbound-unaccounted-transfer": (
+        "jax.device_put / jnp.asarray in bridge/ outside the "
+        "_accounted_place wrapper — bytes ship off the books of "
+        "dmlc_transfer_bytes_total and the trace critical path"),
+    "jaxbound-wide-wire": (
+        "binned (narrow-wire) data cast to float32/float64 before a "
+        "transfer — re-inflates the uint8 wire diet ~4x; widen on device "
+        "inside the jit instead"),
+    "jaxbound-jit-in-hot-path": (
+        "jax.jit wrapper rebuilt per call (immediately invoked or bound "
+        "to a call-only local): the compile cache is always empty, so "
+        "every call retraces — store the jitted fn on the instance/"
+        "module or memoize its builder"),
 }
 
 # which pass owns which rule (drives --pass filtering of stale-entry
@@ -148,7 +173,24 @@ RULES_BY_PASS: Dict[str, Tuple[str, ...]] = {
     "contracts": ("contract-undocumented-knob", "contract-undocumented-metric",
                   "contract-undocumented-span", "contract-undocumented-site",
                   "contract-stale-doc-entry"),
+    "escape": ("escape-leak-on-raise", "escape-double-release"),
+    "jaxbound": ("jaxbound-unaccounted-transfer", "jaxbound-wide-wire",
+                 "jaxbound-jit-in-hot-path"),
 }
+
+
+def render_rule_catalog() -> str:
+    """The generated rule-catalog table (committed into docs/analysis.md;
+    ``--emit-rule-catalog`` regenerates it, and
+    ``test_committed_catalogs_match_code`` pins freshness — the analyzer
+    now eats its own cross-artifact dog food)."""
+    lines = ["| pass | rule | what it flags |", "| --- | --- | --- |",
+             "| driver | `syntax` | " + ALL_RULES["syntax"] + " |"]
+    for pass_name in PER_FILE_PASSES + PROJECT_PASSES:
+        for rule in RULES_BY_PASS[pass_name]:
+            desc = " ".join(ALL_RULES[rule].split()).replace("|", "\\|")
+            lines.append(f"| {pass_name} | `{rule}` | {desc} |")
+    return "\n".join(lines)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -476,6 +518,8 @@ def _run_project_passes(selected: Set[str],
     directives in the anchoring file apply exactly like per-file rules."""
     from dmlc_core_tpu.analysis import contracts as contracts_mod
     from dmlc_core_tpu.analysis import deadlock as deadlock_mod
+    from dmlc_core_tpu.analysis import escape as escape_mod
+    from dmlc_core_tpu.analysis import jaxbound as jaxbound_mod
     from dmlc_core_tpu.analysis.graph import ProjectGraph
 
     graph = ProjectGraph(contexts)
@@ -485,6 +529,10 @@ def _run_project_passes(selected: Set[str],
     if "contracts" in selected:
         findings += contracts_mod.run_project(
             graph, contracts_mod.load_docs(ROOT))
+    if "escape" in selected:
+        findings += escape_mod.run_project(graph)
+    if "jaxbound" in selected:
+        findings += jaxbound_mod.run_project(graph)
     supp_by_file: Dict[str, Dict[int, Set[str]]] = {}
     for ctx in contexts:
         supp_by_file[ctx.relpath] = suppressed_lines(ctx.source)
@@ -585,6 +633,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--emit-span-catalog", action="store_true",
                         help="print the generated telemetry span catalog "
                              "markdown table and exit")
+    parser.add_argument("--emit-rule-catalog", action="store_true",
+                        help="print the generated rule catalog markdown "
+                             "table (committed in docs/analysis.md) and "
+                             "exit")
     return parser
 
 
@@ -627,6 +679,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as exc:
         print(f"dmlclint: {exc}", file=sys.stderr)
         return 2
+
+    if args.emit_rule_catalog:
+        # no graph needed: the rule catalog is pure registry truth
+        print(render_rule_catalog())
+        return 0
 
     if args.emit_knob_catalog or args.emit_span_catalog:
         from dmlc_core_tpu.analysis import contracts as contracts_mod

@@ -260,6 +260,32 @@ def _telemetry_call(node: ast.AST,
             "jit/pallas boundary")
 
 
+def _enabled_gated(ctx: FileContext, node: ast.AST,
+                   telemetry_names: Tuple[Set[str], Set[str]]) -> bool:
+    """Is ``node`` inside an ``if telemetry.enabled():`` block?  The
+    PR 7 transfer-accounting idiom: host-side metering in bridge code is
+    deliberately gated on the telemetry switch, which both documents the
+    intent and makes the disabled mode a no-op — such calls don't need a
+    suppression comment.  (The gate itself still evaluates at trace time;
+    the rule's job is flagging *accidental* telemetry in traced code.)"""
+    mods, funcs = telemetry_names
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.If):
+            for sub in ast.walk(cur.test):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = dotted_name(sub.func) or ""
+                if name.rsplit(".", 1)[-1] != "enabled":
+                    continue
+                root = name.split(".")[0]
+                if (root in mods or "enabled" in funcs
+                        or name.startswith("dmlc_core_tpu.telemetry.")):
+                    return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
 def _impure_call(node: ast.AST, random_aliases: Set[str]) -> Optional[str]:
     if not isinstance(node, ast.Call):
         return None
@@ -309,7 +335,8 @@ def _check_traced(ctx: FileContext, fn: _FuncNode, numpy_aliases: Set[str],
                 continue
             tel_msg = _telemetry_call(node, telemetry_names)
             if tel_msg is not None:
-                yield ctx.finding("purity-telemetry-call", node, tel_msg)
+                if not _enabled_gated(ctx, node, telemetry_names):
+                    yield ctx.finding("purity-telemetry-call", node, tel_msg)
                 continue
             impure = _impure_call(node, random_aliases)
             if impure is not None:

@@ -29,13 +29,72 @@ from typing import Iterable, List
 
 from dmlc_core_tpu.analysis.driver import FileContext, Finding, dotted_name
 
-__all__ = ["run", "OPENER_CALLS"]
+__all__ = ["run", "OPENER_CALLS", "ACQUISITIONS", "RELEASE_METHODS",
+           "RELEASE_FUNCS", "acquisition_kind"]
 
-OPENER_CALLS = {
-    "open", "io.open", "gzip.open", "bz2.open", "lzma.open", "os.fdopen",
-    "socket.socket", "socket.create_connection",
-    "tempfile.TemporaryFile", "tempfile.NamedTemporaryFile",
+# -- the shared acquisition table ---------------------------------------------
+#
+# ONE extensible table of "this call acquires an OS resource" knowledge,
+# consumed by two passes: this per-file pass checks the file/socket/temp
+# subset with its lexical heuristics, and the interprocedural escape pass
+# (pass 8, escape.py) tracks EVERY kind through def-use chains with
+# exception edges.  Keys are dotted-name patterns matched against the
+# full dotted call name and its one/two-component suffixes; values are
+# the resource kind (drives per-kind release vocabulary and messages).
+ACQUISITIONS = {
+    "open": "file", "io.open": "file", "gzip.open": "file",
+    "bz2.open": "file", "lzma.open": "file", "os.fdopen": "file",
+    "tempfile.TemporaryFile": "file", "tempfile.NamedTemporaryFile": "file",
+    "socket.socket": "socket", "socket.create_connection": "socket",
+    "tempfile.mkdtemp": "tempdir", "mkdtemp": "tempdir",
+    "os.open": "fd",
+    "SharedMemory": "shm", "shared_memory.SharedMemory": "shm",
+    "ThreadPoolExecutor": "executor", "ProcessPoolExecutor": "executor",
+    "futures.ThreadPoolExecutor": "executor",
+    "futures.ProcessPoolExecutor": "executor",
+    "mmap.mmap": "mmap",
 }
+
+# method names that release a resource, by kind (None key = any kind)
+RELEASE_METHODS = {
+    None: {"close", "detach"},
+    "socket": {"close"},
+    "executor": {"shutdown"},
+    "shm": {"close", "unlink"},
+    "mmap": {"close"},
+}
+
+# function-style releases: shutil.rmtree(x) / os.close(fd) — matched on
+# the call's last dotted component
+RELEASE_FUNCS = {"rmtree", "rmdir"}
+
+
+def acquisition_kind(name: str) -> "str | None":
+    """Resource kind for a dotted call name, or None.  Matches the full
+    name, then its two- and one-component suffixes, so both
+    ``multiprocessing.shared_memory.SharedMemory`` and a bare
+    ``SharedMemory`` import resolve."""
+    if not name:
+        return None
+    if name in ACQUISITIONS:
+        return ACQUISITIONS[name]
+    parts = name.split(".")
+    if len(parts) >= 2 and ".".join(parts[-2:]) in ACQUISITIONS:
+        return ACQUISITIONS[".".join(parts[-2:])]
+    # bare-suffix matches are restricted to unambiguous class names —
+    # a one-component "open" suffix would match every `x.open()` method
+    if parts[-1] in ("SharedMemory", "ThreadPoolExecutor",
+                     "ProcessPoolExecutor", "mkdtemp"):
+        return ACQUISITIONS[parts[-1]]
+    return None
+
+
+# the per-file rule keeps its historical scope: short-lifetime handle
+# kinds whose "handed to a call / stored on self" heuristics are sound.
+# The executor/shm/mmap kinds have ownership-structured lifetimes that
+# only the escape pass's dataflow models without false positives.
+OPENER_CALLS = {name for name, kind in ACQUISITIONS.items()
+                if kind in ("file", "socket")}
 
 _TEMPDIR_CALLS = {"tempfile.mkdtemp", "mkdtemp"}
 

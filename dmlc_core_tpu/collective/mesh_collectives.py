@@ -169,12 +169,26 @@ class MeshCollective:
         return self._broadcast_fn(root)(x)
 
 
+# compiled ring kernels keyed by (mesh, axis): rebuilding the jit wrapper
+# per ring_allreduce call emptied its compile cache every time, so every
+# call paid a full retrace (jax.Mesh is hashable and meshes are few and
+# long-lived, so a plain dict is the right cache)
+_RING_FNS: dict = {}
+
+
 def ring_allreduce(mesh, axis: str, x):
     """Explicit bidirectional-free ppermute ring allreduce
     (reduce-scatter phase + all-gather phase), shard_map'd over ``axis``.
 
     The per-shard input must be divisible into ``axis_size`` equal segments on
     dim 0."""
+    fn = _RING_FNS.get((mesh, axis))
+    if fn is None:
+        fn = _RING_FNS[(mesh, axis)] = _build_ring_allreduce(mesh, axis)
+    return fn(x)
+
+
+def _build_ring_allreduce(mesh, axis: str):
     import jax
     import jax.lax as lax
     import jax.numpy as jnp  # noqa: F401
@@ -212,8 +226,8 @@ def ring_allreduce(mesh, axis: str, x):
         segs = lax.fori_loop(0, n - 1, ag_step, segs)
         return segs.reshape((-1,) + x.shape[1:])
 
-    fn = jax.jit(shard_map(kernel, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
-    return fn(x)
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=P(axis),
+                             out_specs=P(axis)))
 
 
 def allreduce_bandwidth_gbps(mesh, axis: str, nbytes: int = 64 << 20,

@@ -81,12 +81,20 @@ class PageCacheWriter:
         self._path = path
         self._index_dtype = np.dtype(index_dtype)
         self._tmp = f"{path}.build-{os.getpid()}.tmp"
-        self._fo = open(self._tmp, "wb")
         self._page_offsets: List[int] = []
         self._pos = 0
-        self._write(_HEAD.pack(HEAD_MAGIC, VERSION,
-                               _dtype_tag(self._index_dtype)))
         self.pages_written = 0
+        self._fo = open(self._tmp, "wb")
+        try:
+            self._write(_HEAD.pack(HEAD_MAGIC, VERSION,
+                                   _dtype_tag(self._index_dtype)))
+        except BaseException:
+            # a failed header write (disk full) must not orphan the fd and
+            # the temp file: the caller never receives the instance, so
+            # abort() is unreachable
+            self._fo.close()
+            os.unlink(self._tmp)
+            raise
 
     def _write(self, data: bytes) -> None:
         self._fo.write(data)
@@ -171,7 +179,14 @@ class PageCacheReader:
             raise CacheFormatError(f"{path}: too small for a v2 cache "
                                    f"({size} bytes)")
         self._fd = open(path, "rb")
-        self._mm = mmap.mmap(self._fd.fileno(), 0, access=mmap.ACCESS_READ)
+        try:
+            self._mm = mmap.mmap(self._fd.fileno(), 0,
+                                 access=mmap.ACCESS_READ)
+        except BaseException:
+            # a failed mmap orphans the fd: close() can never reach it
+            # because the constructor raise means no one holds the instance
+            self._fd.close()
+            raise
         try:
             self._pages = self._load_pages(size)
         except Exception:

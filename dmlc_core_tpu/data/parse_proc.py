@@ -192,11 +192,18 @@ def _worker_parse(spec: Tuple[str, str, Dict[str, Any]], data: bytes,
                 cols.append((name, "", 0, 0))
             arrays.append(arr)
         shm = shared_memory.SharedMemory(create=True, size=max(total, 1))
-        for (name, _, off, nbytes), arr in zip(cols, arrays):
-            if nbytes:
-                np.frombuffer(shm.buf, np.uint8, nbytes, off)[:] = \
-                    arr.view(np.uint8).reshape(-1)
-        meta.update(shm=shm.name, nbytes=total, cols=cols)
+        try:
+            for (name, _, off, nbytes), arr in zip(cols, arrays):
+                if nbytes:
+                    np.frombuffer(shm.buf, np.uint8, nbytes, off)[:] = \
+                        arr.view(np.uint8).reshape(-1)
+            meta.update(shm=shm.name, nbytes=total, cols=cols)
+        except BaseException:
+            # the consumer never learns this segment's name: unlink it
+            # HERE or the bytes sit in /dev/shm until reboot
+            shm.close()
+            shm.unlink()
+            raise
         shm.close()
         _untrack(shm)
     meta["busy_s"] = time.monotonic() - t0
@@ -259,13 +266,26 @@ def attach_block(meta: Dict[str, Any], index_dtype) -> RowBlockContainer:
     if getattr(shm, "_fd", -1) >= 0:  # mmap no longer needs the fd
         os.close(shm._fd)
         shm._fd = -1
-    seg = np.frombuffer(buf, dtype=np.uint8)
-    track = meta["nbytes"] if telemetry.enabled() else 0
-    if track:
-        telemetry.gauge_add("dmlc_parse_shm_bytes_in_flight", track)
-    # every column view chains its .base to `seg`; when the last view dies,
-    # seg dies, and the finalizer releases the mapping
-    weakref.finalize(seg, _release_lease, mm, buf, track)
+    try:
+        seg = np.frombuffer(buf, dtype=np.uint8)
+        track = meta["nbytes"] if telemetry.enabled() else 0
+        if track:
+            telemetry.gauge_add("dmlc_parse_shm_bytes_in_flight", track)
+    except BaseException:
+        # no finalizer is registered yet: release the stolen mapping here
+        # or it outlives every view that could ever free it.  Gauge delta
+        # 0: gauge_add raising means the increment never landed.
+        _release_lease(mm, buf, 0)
+        raise
+    try:
+        # every column view chains its .base to `seg`; when the last view
+        # dies, seg dies, and the finalizer releases the mapping
+        weakref.finalize(seg, _release_lease, mm, buf, track)
+    except BaseException:
+        # the increment above DID land: release with the full delta so
+        # the in-flight gauge cannot drift upward on this path
+        _release_lease(mm, buf, track)
+        raise
     views: Dict[str, Optional[np.ndarray]] = {}
     for name, dtype_str, off, nbytes in meta["cols"]:
         views[name] = (seg[off:off + nbytes].view(dtype_str)

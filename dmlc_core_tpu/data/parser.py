@@ -111,12 +111,14 @@ class TextParserBase(ParserImpl):
         self._source = source
         self._bytes_read = 0
         self._nthread = max(1, nthread)
-        self._pool = (ThreadPoolExecutor(max_workers=self._nthread,
-                                         thread_name_prefix="dmlc-parse")
-                      if self._nthread > 1 else None)
         self._nproc = parse_proc.resolve_nproc()
         self._proc_pool: Optional[parse_proc.ProcParsePool] = None
         self._proc_off = self._nproc < 2
+        # acquired last: every statement after this is a plain assignment,
+        # so a constructor failure can never orphan the executor
+        self._pool = (ThreadPoolExecutor(max_workers=self._nthread,
+                                         thread_name_prefix="dmlc-parse")
+                      if self._nthread > 1 else None)
 
     def before_first(self) -> None:
         self._source.before_first()

@@ -754,15 +754,17 @@ class SingleFileSplit(InputSplit):
     (reference src/io/single_file_split.h:27-173)."""
 
     def __init__(self, uri: str):
+        self._cursor = ChunkCursor()
+        self._buffer_size = DEFAULT_BUFFER_SIZE
+        self._eof = False
+        # opened last: a constructor failure after the open would orphan
+        # the fd (no caller ever holds the instance to close it)
         if uri in ("stdin", "-"):
             self._f = sys.stdin.buffer
             self._stdin = True
         else:
             self._f = open(uri, "rb")
             self._stdin = False
-        self._cursor = ChunkCursor()
-        self._buffer_size = DEFAULT_BUFFER_SIZE
-        self._eof = False
 
     def before_first(self) -> None:
         CHECK(not self._stdin, "cannot rewind stdin")
@@ -881,10 +883,17 @@ class CachedInputSplit(InputSplit):
         self._base = base
         self._cache_file = cache_file
         self._cursor = ChunkCursor()
-        self._cache_fo = open(cache_file, "wb")
         self._preproc = True
-        self._iter = ThreadedIter(self._make_preproc_producer(), max_capacity=2,
-                                  name="split_preproc")
+        self._cache_fo = open(cache_file, "wb")
+        try:
+            self._iter = ThreadedIter(self._make_preproc_producer(),
+                                      max_capacity=2, name="split_preproc")
+        except BaseException:
+            # a failed producer bring-up orphans the cache fd (and leaves a
+            # zero-byte cache file): the caller never gets the instance,
+            # so close() is unreachable
+            self._cache_fo.close()
+            raise
 
     def _make_preproc_producer(self):
         parent = self

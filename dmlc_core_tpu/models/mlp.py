@@ -123,11 +123,20 @@ class MLP:
         fn, _ = self._train_step()
         return fn(params, opt_state, batch)
 
+    @functools.lru_cache(maxsize=None)
+    def _predict_fn(self):
+        import jax
+
+        # memoized like _train_step: `jax.jit(self._apply)(x)` per call
+        # built a fresh wrapper (and a fresh bound method) each predict,
+        # so the compile cache never hit and every call retraced
+        return jax.jit(self._apply)
+
     def predict(self, params, x):
         import jax
         import jax.numpy as jnp
 
-        logits = jax.jit(self._apply)(params, jnp.asarray(x))
+        logits = self._predict_fn()(params, jnp.asarray(x))
         if self.param.num_class == 1:
             return logits[:, 0]
         return jax.nn.softmax(logits, axis=-1)

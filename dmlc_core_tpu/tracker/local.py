@@ -57,7 +57,13 @@ def submit(opts) -> None:
     job_dir = None
     if files or archives:
         job_dir = tempfile.mkdtemp(prefix="dmlc-job-")
-        stage_job_dir(files, archives, job_dir)
+        try:
+            stage_job_dir(files, archives, job_dir)
+        except BaseException:
+            # staging failed before anything owns the dir: fun_submit's
+            # finally (the normal cleanup path) never runs on this edge
+            shutil.rmtree(job_dir, ignore_errors=True)
+            raise
         ship_env["DMLC_JOB_CWD"] = job_dir
         logger.info("staged %d files / %d archives into %s",
                     len(files), len(archives), job_dir)
@@ -92,4 +98,12 @@ def submit(opts) -> None:
             if job_dir is not None:
                 shutil.rmtree(job_dir, ignore_errors=True)
 
-    submit_job(opts, fun_submit, wait=False)
+    try:
+        submit_job(opts, fun_submit, wait=False)
+    except BaseException:
+        # tracker bring-up can fail before fun_submit (and its finally)
+        # ever runs; fun_submit's own cleanup already ran when it did run,
+        # and rmtree(ignore_errors) is safe to repeat
+        if job_dir is not None:
+            shutil.rmtree(job_dir, ignore_errors=True)
+        raise

@@ -342,8 +342,6 @@ class RabitTracker:
                  port_end: int = 9999,
                  sock_timeout: Optional[float] = None,
                  rendezvous_deadline: Optional[float] = None):
-        self.sock, self.port = bind_free_port(host_ip, port, port_end)
-        self.sock.listen(256)
         self.host_ip = host_ip
         self.num_workers = num_workers
         self.thread: Optional[threading.Thread] = None
@@ -367,6 +365,15 @@ class RabitTracker:
         self.trace = tracecontext.TraceContext(tracecontext.new_trace_id(),
                                                tracecontext.new_span_id())
         self._constructed_at = clock.monotonic()
+        # the port is bound LAST: a constructor failure after the bind
+        # would orphan the listening socket (the caller never receives the
+        # instance, so the accept loop's own close can never run)
+        self.sock, self.port = bind_free_port(host_ip, port, port_end)
+        try:
+            self.sock.listen(256)
+        except BaseException:
+            self.sock.close()
+            raise
         logger.info("start listening on %s:%d", host_ip, self.port)
 
     # -- topology (tracker.py:165-252) ---------------------------------------
@@ -523,6 +530,10 @@ class RabitTracker:
         while len(set(shutdown) | set(self.failed_ranks)) < n:
             if deadline_at is not None and clock.monotonic() > deadline_at:
                 self._rendezvous_expired(pending, todo_nodes, n)
+                # the deadline exit must drop served shutdown connections
+                # too, or their fds stay pinned exactly like the normal
+                # exit used to leave them
+                self._close_worker_socks(shutdown.values())
                 return
             try:
                 fd, addr = self.sock.accept()
@@ -554,6 +565,13 @@ class RabitTracker:
                     self._reject(fd, "print", err)
                     continue
                 logger.info(msg.strip())
+                try:
+                    # one connection per print message: dropping the fd
+                    # here used to park it on the GC (one leaked fd per
+                    # print for the life of the rendezvous)
+                    fd.close()
+                except OSError:
+                    pass
                 continue
             if s.cmd == "shutdown":
                 # rank must name a real slot: out-of-world shutdowns would
@@ -648,8 +666,19 @@ class RabitTracker:
                     if s.pending_accepts > 0:
                         accept_registry[rank] = s
         self.end_time = time.time()
+        self._close_worker_socks(shutdown.values())
         logger.info("@tracker all nodes finished; %.3f secs between start and finish",
                     (self.end_time - (self.start_time or self.end_time)))
+
+    @staticmethod
+    def _close_worker_socks(entries) -> None:
+        """Close served connections; they pin one fd per rank until the
+        tracker object is collected otherwise."""
+        for entry in entries:
+            try:
+                entry.sock.sock.close()
+            except OSError:
+                pass
 
     def _rendezvous_expired(self, pending: List[WorkerEntry],
                             todo_nodes: List[int], n: int) -> None:
@@ -665,11 +694,7 @@ class RabitTracker:
                       f"{missing} of {n} rank(s) never started")
         logger.error("%s", self.error)
         telemetry.count("dmlc_tracker_deadline_exceeded_total")
-        for p in pending:
-            try:
-                p.sock.sock.close()
-            except OSError:
-                pass
+        self._close_worker_socks(pending)
 
     def start(self, num_workers: Optional[int] = None) -> None:
         n = num_workers if num_workers is not None else self.num_workers

@@ -58,12 +58,17 @@ def run_ferried(tasks: Sequence[Tuple[str, Callable[[], None]]]) -> None:
 def _default_host_ip() -> str:
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect(("10.255.255.255", 1))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
     except OSError:
         return "127.0.0.1"
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        # the old shape leaked the probe socket here: connect() failing
+        # (no route) jumped past s.close() straight to the handler
+        return "127.0.0.1"
+    finally:
+        s.close()
 
 
 def submit_job(opts, fun_submit: Callable[[Dict[str, str]], None],

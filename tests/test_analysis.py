@@ -2056,3 +2056,1110 @@ def test_cli_empty_pass_spec_is_usage_error(capsys):
     assert "names no pass" in capsys.readouterr().err
     assert main(["--pass", " , "]) == 2
     capsys.readouterr()
+
+
+# -- pass 8: escape (resource-escape dataflow) --------------------------------
+
+def _escape_findings(files):
+    from dmlc_core_tpu.analysis import escape
+
+    return escape.run_project(_graph(files))
+
+
+LEAK_ON_HANDLED_EDGE = {
+    "dmlc_core_tpu/e.py": """
+        import socket
+
+        def host_ip():
+            s = socket.socket()
+            try:
+                s.connect(("10.255.255.255", 1))
+                ip = s.getsockname()[0]
+                s.close()
+                return ip
+            except OSError:
+                return "127.0.0.1"
+    """,
+}
+
+
+def test_escape_leak_on_handled_exception_path_trips():
+    # the _default_host_ip shape: close() on the happy path only — the
+    # except arm returns with the socket still open
+    found = _escape_findings(LEAK_ON_HANDLED_EDGE)
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert found[0].symbol == "host_ip"
+    assert "'s' (socket)" in found[0].message
+
+
+def test_escape_finally_release_clean_twin():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def host_ip():
+                s = socket.socket()
+                try:
+                    s.connect(("10.255.255.255", 1))
+                    return s.getsockname()[0]
+                except OSError:
+                    return "127.0.0.1"
+                finally:
+                    s.close()
+        """,
+    }) == []
+
+
+def test_escape_with_statement_clean_twin():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def host_ip():
+                with socket.socket() as s:
+                    s.connect(("10.255.255.255", 1))
+                    return s.getsockname()[0]
+        """,
+    }) == []
+
+
+def test_escape_release_only_in_narrow_except_trips():
+    # release in `except ValueError` only: every OTHER exception type
+    # rides the unhandled edge out with the handle still open
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            def read(p):
+                f = open(p, "rb")
+                try:
+                    data = f.read()
+                except ValueError:
+                    f.close()
+                    raise
+                f.close()
+                return data
+        """,
+    })
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+
+
+def test_escape_catch_all_reraise_clean_twin():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            def read(p):
+                f = open(p, "rb")
+                try:
+                    data = f.read()
+                except BaseException:
+                    f.close()
+                    raise
+                f.close()
+                return data
+        """,
+    }) == []
+
+
+def test_escape_raise_between_acquire_and_protection_trips():
+    # the window BEFORE the try/finally: validate(p) raising leaks f
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            def read(p):
+                f = open(p, "rb")
+                validate(p)
+                try:
+                    return f.read()
+                finally:
+                    f.close()
+
+            def validate(p):
+                if not p:
+                    raise ValueError(p)
+        """,
+    })
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert found[0].symbol == "read"
+
+
+def test_escape_leak_through_readonly_helper_trips():
+    # interprocedural: `use(s)` is project-resolved and only READS its
+    # parameter, so the caller still owns the socket when use() raises —
+    # the per-file pass calls any call-arg a hand-off and misses this
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def probe(addr):
+                s = socket.socket()
+                use(s, addr)
+                s.close()
+
+            def use(sock, addr):
+                sock.connect(addr)
+        """,
+    })
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert found[0].symbol == "probe"
+
+
+def test_escape_helper_that_releases_is_clean():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def probe(addr):
+                s = socket.socket()
+                finish(s)
+
+            def finish(sock):
+                sock.close()
+        """,
+    }) == []
+
+
+def test_escape_unresolved_callee_still_transfers():
+    # the Reader(open(...))-by-name idiom: an external callee is assumed
+    # to take ownership, exactly like the per-file pass
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import io
+
+            def wrap(p):
+                f = open(p, "rb")
+                return io.BufferedReader(f)
+        """,
+    }) == []
+
+
+def test_escape_ownership_transfer_via_return_is_clean():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def make():
+                s = socket.socket()
+                return s
+        """,
+    }) == []
+
+
+def test_escape_acquire_through_helper_return_trips():
+    # the caller of a resource-returning helper becomes the acquirer —
+    # invisible to the per-file pass (no opener call in sight)
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def make():
+                s = socket.socket()
+                return s
+
+            def leaky(addr):
+                s = make()
+                s.connect(addr)
+        """,
+    })
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert found[0].symbol == "leaky"
+    assert "helper's return" in found[0].message
+
+
+def test_escape_helper_return_closed_by_caller_is_clean():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def make():
+                s = socket.socket()
+                return s
+
+            def fine(addr):
+                s = make()
+                try:
+                    s.connect(addr)
+                finally:
+                    s.close()
+        """,
+    }) == []
+
+
+def test_escape_tuple_return_acquisition_tracked():
+    # the bind_free_port shape: the resource rides at tuple index 0
+    files = {
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def bind_free(host):
+                sock = socket.socket()
+                try:
+                    sock.bind((host, 0))
+                    return sock, 9091
+                except BaseException:
+                    sock.close()
+                    raise
+
+            def caller(host):
+                sock, port = bind_free(host)
+                announce(port)
+
+            def announce(port):
+                pass
+        """,
+    }
+    found = _escape_findings(files)
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert found[0].symbol == "caller"
+    clean = dict(files)
+    clean["dmlc_core_tpu/e.py"] = files["dmlc_core_tpu/e.py"].replace(
+        "                announce(port)",
+        "                try:\n"
+        "                    announce(port)\n"
+        "                finally:\n"
+        "                    sock.close()")
+    assert _escape_findings(clean) == []
+
+
+def test_escape_self_owned_with_close_method_is_clean():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            class Conn:
+                def __init__(self, addr):
+                    self._addr = addr
+                    self._sock = socket.socket()
+
+                def close(self):
+                    self._sock.close()
+        """,
+    }) == []
+
+
+def test_escape_class_never_releases_attr_trips():
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            class Conn:
+                def __init__(self, addr):
+                    self._addr = addr
+                    self._sock = socket.socket()
+
+                def send(self, data):
+                    self._sock.sendall(data)
+        """,
+    })
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert found[0].symbol == "Conn._sock"
+    assert "no method" in found[0].message
+
+
+def test_escape_init_raise_window_trips():
+    # self.X = acquire() then a raising statement: the caller never gets
+    # the instance, so close() is unreachable — the six-constructor bug
+    # class this pass surfaced at introduction
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            class Conn:
+                def __init__(self, addr):
+                    self._sock = socket.socket()
+                    self._sock.connect(addr)
+
+                def close(self):
+                    self._sock.close()
+        """,
+    })
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert found[0].symbol == "Conn.__init__"
+    assert "__init__" in found[0].message
+
+
+def test_escape_init_guarded_by_handler_is_clean():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            class Conn:
+                def __init__(self, addr):
+                    self._sock = socket.socket()
+                    try:
+                        self._sock.connect(addr)
+                    except BaseException:
+                        self._sock.close()
+                        raise
+
+                def close(self):
+                    self._sock.close()
+        """,
+    }) == []
+
+
+def test_escape_init_guarded_by_self_close_is_clean():
+    # the handler releases through a method of the class (interprocedural
+    # attr-release summary)
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            class Conn:
+                def __init__(self, addr):
+                    self._sock = socket.socket()
+                    try:
+                        self._sock.connect(addr)
+                    except BaseException:
+                        self.close()
+                        raise
+
+                def close(self):
+                    self._sock.close()
+        """,
+    }) == []
+
+
+def test_escape_mention_is_not_a_store():
+    # `self._mm = mmap.mmap(self._fd.fileno(), 0)` only READS _fd — the
+    # PageCacheReader regression: the old model called it a transfer
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import mmap
+
+            class R:
+                def __init__(self, path):
+                    self._fd = open(path, "rb")
+                    self._mm = mmap.mmap(self._fd.fileno(), 0)
+
+                def close(self):
+                    self._mm.close()
+                    self._fd.close()
+        """,
+    })
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert "_fd" in found[0].message
+
+
+def test_escape_global_store_is_a_transfer():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            from concurrent.futures import ProcessPoolExecutor
+
+            _pool = None
+
+            def get_pool(n):
+                global _pool
+                pool = ProcessPoolExecutor(max_workers=n)
+                _pool = pool
+                return _pool
+        """,
+    }) == []
+
+
+def test_escape_warmup_probe_shape_is_clean():
+    # the hardened parse_proc._get_shared_pool shape: probe under a
+    # catch-all that shuts the executor down, then park it in a global
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            from concurrent.futures import ProcessPoolExecutor
+
+            _pool = None
+
+            def bring_up(n):
+                global _pool
+                pool = ProcessPoolExecutor(max_workers=n)
+                try:
+                    pool.submit(probe).result(120.0)
+                except BaseException:
+                    pool.shutdown(wait=False)
+                    raise
+                _pool = pool
+
+            def probe():
+                return True
+        """,
+    }) == []
+
+
+def test_escape_shm_live_on_every_path_trips():
+    # shm is outside the per-file opener subset: all-paths-live is
+    # reported HERE or nowhere
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            from multiprocessing import shared_memory
+
+            def stage(total):
+                shm = shared_memory.SharedMemory(create=True, size=total)
+                fill(shm)
+
+            def fill(seg):
+                pass
+        """,
+    })
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert "never released" in found[0].message
+
+
+def test_escape_shm_worker_parse_shape_clean():
+    # the FIXED _worker_parse shape: catch-all unlinks, normal closes
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            from multiprocessing import shared_memory
+
+            def stage(data):
+                shm = shared_memory.SharedMemory(create=True, size=len(data))
+                try:
+                    fill(shm, data)
+                except BaseException:
+                    shm.close()
+                    shm.unlink()
+                    raise
+                shm.close()
+                return shm.name
+
+            def fill(seg, data):
+                seg.buf[:len(data)] = data
+        """,
+    }) == []
+
+
+def test_escape_double_release_same_method_trips():
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            from multiprocessing import shared_memory
+
+            def drop(name):
+                seg = shared_memory.SharedMemory(name=name)
+                try:
+                    seg.unlink()
+                except OSError:
+                    pass
+                seg.unlink()
+                seg.close()
+        """,
+    })
+    assert "escape-double-release" in [f.rule for f in found]
+
+
+def test_escape_close_then_unlink_is_not_double_release():
+    # the correct FULL release of a SharedMemory segment
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            from multiprocessing import shared_memory
+
+            def drop(name):
+                seg = shared_memory.SharedMemory(name=name)
+                seg.close()
+                seg.unlink()
+        """,
+    }) == []
+
+
+def test_escape_rmtree_twice_trips():
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import shutil
+            import tempfile
+
+            def build(stage):
+                d = tempfile.mkdtemp()
+                try:
+                    stage(d)
+                except ValueError:
+                    shutil.rmtree(d)
+                shutil.rmtree(d)
+        """,
+    })
+    assert "escape-double-release" in [f.rule for f in found]
+
+
+def test_escape_staged_tempdir_shape():
+    # the tracker/local.py bug: cleanup lives in a nested def the error
+    # path never runs; the dict store is where ownership really moves
+    files = {
+        "dmlc_core_tpu/e.py": """
+            import shutil
+            import tempfile
+
+            def submit(env):
+                d = tempfile.mkdtemp()
+                stage(d)
+                env["JOB_CWD"] = d
+
+            def stage(dest):
+                if not dest:
+                    raise ValueError(dest)
+        """,
+    }
+    found = _escape_findings(files)
+    assert [f.rule for f in found] == ["escape-leak-on-raise"]
+    assert found[0].symbol == "submit"
+    clean = dict(files)
+    clean["dmlc_core_tpu/e.py"] = files["dmlc_core_tpu/e.py"].replace(
+        "                stage(d)",
+        "                try:\n"
+        "                    stage(d)\n"
+        "                except BaseException:\n"
+        "                    shutil.rmtree(d, ignore_errors=True)\n"
+        "                    raise")
+    assert _escape_findings(clean) == []
+
+
+def test_escape_rebind_drops_tracking():
+    # documented approximation: rebinding the name ends tracking
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def odd():
+                s = socket.socket()
+                s = None
+                return s
+        """,
+    }) == []
+
+
+def test_escape_alias_release_counts():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import socket
+
+            def probe(addr):
+                s = socket.socket()
+                t = s
+                try:
+                    s.connect(addr)
+                finally:
+                    t.close()
+        """,
+    }) == []
+
+
+def test_escape_return_through_finally_is_a_transfer():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            def grab(p, note):
+                f = open(p, "rb")
+                try:
+                    return f
+                finally:
+                    note(p)
+        """,
+    }) == []
+
+
+def test_escape_loop_acquire_release_clean():
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            def scan(paths):
+                out = []
+                for p in paths:
+                    f = open(p, "rb")
+                    try:
+                        out.append(f.read(1))
+                    finally:
+                        f.close()
+                return out
+        """,
+    }) == []
+
+
+def test_escape_suppression_works_like_any_project_rule():
+    from dmlc_core_tpu.analysis.driver import _run_project_passes
+
+    src = textwrap.dedent("""
+        import socket
+
+        def host_ip():
+            # dmlclint: disable=escape-leak-on-raise
+            s = socket.socket()
+            try:
+                s.connect(("10.255.255.255", 1))
+                ip = s.getsockname()[0]
+                s.close()
+                return ip
+            except OSError:
+                return "127.0.0.1"
+    """)
+    import ast as _ast
+    from dmlc_core_tpu.analysis.driver import FileContext
+
+    ctx = FileContext("dmlc_core_tpu/e.py", src, _ast.parse(src), True,
+                      False)
+    assert _run_project_passes({"escape"}, [ctx]) == []
+
+
+# -- pass 8: seeded fault twins against the REAL files ------------------------
+
+def _real_source(relpath):
+    with open(os.path.join(REPO, relpath), encoding="utf-8") as f:
+        return f.read()
+
+
+def _escape_on_source(relpath, src):
+    import ast as _ast
+
+    from dmlc_core_tpu.analysis import escape
+    from dmlc_core_tpu.analysis.driver import FileContext
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    ctx = FileContext(relpath, src, _ast.parse(src), True, False)
+    return escape.run_project(ProjectGraph([ctx]))
+
+
+def test_seeded_shm_leak_twin_produces_exactly_one_finding():
+    """Re-introducing the PR 4 shm-leak shape (worker segment not
+    unlinked when the column copy raises) produces exactly ONE finding
+    with the right rule id — the acceptance-criteria detection proof."""
+    src = _real_source("dmlc_core_tpu/data/parse_proc.py")
+    broken = src.replace(
+        "            shm.close()\n"
+        "            shm.unlink()\n"
+        "            raise", "            raise")
+    assert broken != src, "fix shape changed; update the seeding"
+    found = [f for f in _escape_on_source("dmlc_core_tpu/data/parse_proc.py",
+                                          broken)
+             if f.rule.startswith("escape-")]
+    assert len(found) == 1
+    assert found[0].rule == "escape-leak-on-raise"
+    assert found[0].symbol == "_worker_parse"
+
+
+def test_real_parse_proc_is_escape_clean():
+    src = _real_source("dmlc_core_tpu/data/parse_proc.py")
+    assert [f for f in _escape_on_source("dmlc_core_tpu/data/parse_proc.py",
+                                         src)
+            if f.rule.startswith("escape-")] == []
+
+
+def test_seeded_init_leak_twin_in_real_page_cache():
+    """Stripping the PageCacheReader mmap guard re-introduces the
+    orphaned-fd constructor bug and exactly one finding."""
+    src = _real_source("dmlc_core_tpu/data/page_cache.py")
+    broken = src.replace(
+        "        try:\n"
+        "            self._mm = mmap.mmap(self._fd.fileno(), 0,\n"
+        "                                 access=mmap.ACCESS_READ)\n"
+        "        except BaseException:",
+        "        if True:\n"
+        "            self._mm = mmap.mmap(self._fd.fileno(), 0,\n"
+        "                                 access=mmap.ACCESS_READ)\n"
+        "        elif True:")
+    assert broken != src, "fix shape changed; update the seeding"
+    found = [f for f in _escape_on_source("dmlc_core_tpu/data/page_cache.py",
+                                          broken)
+             if f.rule.startswith("escape-")
+             and f.symbol == "PageCacheReader.__init__"]
+    assert len(found) == 1
+    assert found[0].rule == "escape-leak-on-raise"
+
+
+# -- pass 9: jaxbound ---------------------------------------------------------
+
+def _jaxbound_findings(files):
+    from dmlc_core_tpu.analysis import jaxbound
+
+    return jaxbound.run_project(_graph(files))
+
+
+def test_jaxbound_unaccounted_device_put_trips():
+    found = _jaxbound_findings({
+        "dmlc_core_tpu/bridge/rogue.py": """
+            import jax
+
+            def ship(batch, device):
+                return jax.device_put(batch, device)
+        """,
+    })
+    assert [f.rule for f in found] == ["jaxbound-unaccounted-transfer"]
+    assert found[0].symbol == "ship"
+
+
+def test_jaxbound_accounted_place_wrapped_is_clean():
+    assert _jaxbound_findings({
+        "dmlc_core_tpu/bridge/ok.py": """
+            import jax
+
+            def _accounted_place(inner, path):
+                def place(batch):
+                    return inner(batch)
+                return place
+
+            def feed(device):
+                def inner(batch):
+                    return jax.device_put(batch, device)
+                return _accounted_place(inner, "device_feed")
+        """,
+    }) == []
+
+
+def test_jaxbound_nonbridge_device_put_not_flagged():
+    assert [f.rule for f in _jaxbound_findings({
+        "dmlc_core_tpu/models/m.py": """
+            import jax
+
+            def stage(x, device):
+                return jax.device_put(x, device)
+        """,
+    })] == []
+
+
+def test_jaxbound_jnp_asarray_in_bridge_trips_numpy_does_not():
+    found = _jaxbound_findings({
+        "dmlc_core_tpu/bridge/r.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def implicit(x):
+                return jnp.asarray(x)
+
+            def host_side(x):
+                return np.asarray(x)
+        """,
+    })
+    assert [f.rule for f in found] == ["jaxbound-unaccounted-transfer"]
+    assert found[0].symbol == "implicit"
+
+
+def test_jaxbound_traced_asarray_is_clean():
+    # inside jit-reachable code asarray of a tracer is free — exempt
+    assert _jaxbound_findings({
+        "dmlc_core_tpu/bridge/t.py": """
+            import jax
+            import jax.numpy as jnp
+
+            def kernel(x):
+                return jnp.asarray(x) * 2
+
+            step = jax.jit(kernel)
+
+            def launch(x):
+                return step(x)
+        """,
+    }) == []
+
+
+def test_jaxbound_wide_wire_trips_and_narrow_twin_clean():
+    files = {
+        "dmlc_core_tpu/bridge/w.py": """
+            import jax
+            import numpy as np
+
+            def feed(binner, x, device):
+                bins = binner.transform(x)
+                wide = bins.astype(np.float32)
+                return jax.device_put(wide, device)
+        """,
+    }
+    found = _jaxbound_findings(files)
+    assert "jaxbound-wide-wire" in [f.rule for f in found]
+    clean = {
+        "dmlc_core_tpu/bridge/w.py":
+        files["dmlc_core_tpu/bridge/w.py"].replace(
+            "                wide = bins.astype(np.float32)\n"
+            "                return jax.device_put(wide, device)",
+            "                return jax.device_put(bins, device)"),
+    }
+    assert [f.rule for f in _jaxbound_findings(clean)] == \
+        ["jaxbound-unaccounted-transfer"]
+
+
+def test_jaxbound_wide_cast_of_unbinned_data_not_wide_wire():
+    # casting NON-binned data is the legitimate float path
+    found = _jaxbound_findings({
+        "dmlc_core_tpu/bridge/f.py": """
+            import jax
+            import numpy as np
+
+            def feed(x, device):
+                xs = np.asarray(x).astype(np.float32)
+                return jax.device_put(xs, device)
+        """,
+    })
+    assert "jaxbound-wide-wire" not in [f.rule for f in found]
+
+
+def test_jaxbound_jit_immediately_invoked_trips():
+    found = _jaxbound_findings({
+        "dmlc_core_tpu/models/j.py": """
+            import jax
+
+            class M:
+                def predict(self, params, x):
+                    return jax.jit(self._apply)(params, x)
+
+                def _apply(self, params, x):
+                    return x
+        """,
+    })
+    assert [f.rule for f in found] == ["jaxbound-jit-in-hot-path"]
+    assert found[0].symbol == "M.predict"
+    assert "closes over self" in found[0].message
+
+
+def test_jaxbound_jit_returned_is_clean():
+    assert _jaxbound_findings({
+        "dmlc_core_tpu/models/j.py": """
+            import jax
+
+            def build(step):
+                return jax.jit(step, donate_argnums=(0,))
+        """,
+    }) == []
+
+
+def test_jaxbound_jit_under_lru_cache_is_clean():
+    assert _jaxbound_findings({
+        "dmlc_core_tpu/models/j.py": """
+            import functools
+
+            import jax
+
+            class M:
+                @functools.lru_cache(maxsize=None)
+                def _predict_fn(self):
+                    return jax.jit(self._apply)(1, 2)
+
+                def _apply(self, a, b):
+                    return a + b
+        """,
+    }) == []
+
+
+def test_jaxbound_jit_stored_on_self_is_clean():
+    assert _jaxbound_findings({
+        "dmlc_core_tpu/models/j.py": """
+            import jax
+
+            class M:
+                def build(self, predict):
+                    self._jit = jax.jit(predict)
+        """,
+    }) == []
+
+
+def test_jaxbound_jit_dict_cached_is_clean():
+    # the collective/api.py fn_cache shape
+    assert _jaxbound_findings({
+        "dmlc_core_tpu/models/j.py": """
+            import jax
+
+            _cache = {}
+
+            def op(key, slots, garr):
+                fn = _cache.get(key)
+                if fn is None:
+                    fn = jax.jit(lambda x: x[slots])
+                    _cache[key] = fn
+                return fn(garr)
+        """,
+    }) == []
+
+
+def test_jaxbound_jit_local_called_only_trips():
+    found = _jaxbound_findings({
+        "dmlc_core_tpu/models/j.py": """
+            import jax
+
+            def score(params, x):
+                fn = jax.jit(lambda p, v: v)
+                return fn(params, x)
+        """,
+    })
+    assert [f.rule for f in found] == ["jaxbound-jit-in-hot-path"]
+
+
+def test_jaxbound_jit_module_level_is_clean():
+    assert _jaxbound_findings({
+        "dmlc_core_tpu/models/j.py": """
+            import jax
+
+            def _step(x):
+                return x
+
+            step = jax.jit(_step)
+        """,
+    }) == []
+
+
+def test_seeded_unwrapped_device_put_in_real_bridge_trips():
+    """An unwrapped jax.device_put seeded into the REAL bridge/loader.py
+    produces exactly one finding with the right rule id — the second
+    acceptance-criteria detection proof."""
+    import ast as _ast
+
+    from dmlc_core_tpu.analysis import jaxbound
+    from dmlc_core_tpu.analysis.driver import FileContext
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    src = _real_source("dmlc_core_tpu/bridge/loader.py")
+    seeded = src + (
+        "\n\ndef _rogue_ship(batch):\n"
+        "    import jax\n\n"
+        "    return jax.device_put(batch)\n")
+    ctx = FileContext("dmlc_core_tpu/bridge/loader.py", seeded,
+                      _ast.parse(seeded), True, False)
+    found = [f for f in jaxbound.run_project(ProjectGraph([ctx]))
+             if f.rule.startswith("jaxbound-")]
+    assert len(found) == 1
+    assert found[0].rule == "jaxbound-unaccounted-transfer"
+    assert found[0].symbol == "_rogue_ship"
+
+
+def test_real_bridge_and_mlp_are_jaxbound_clean():
+    import ast as _ast
+
+    from dmlc_core_tpu.analysis import jaxbound
+    from dmlc_core_tpu.analysis.driver import FileContext
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    ctxs = []
+    for rel in ("dmlc_core_tpu/bridge/loader.py",
+                "dmlc_core_tpu/bridge/binning.py",
+                "dmlc_core_tpu/bridge/batching.py",
+                "dmlc_core_tpu/models/mlp.py"):
+        src = _real_source(rel)
+        ctxs.append(FileContext(rel, src, _ast.parse(src), True, False))
+    assert jaxbound.run_project(ProjectGraph(ctxs)) == []
+
+
+# -- purity: telemetry.enabled() gating ---------------------------------------
+
+def test_purity_telemetry_enabled_gated_is_clean():
+    # the PR 7 transfer-accounting idiom: gated host-side metering in
+    # bridge code needs no suppression comment
+    assert rules_of("""
+        import jax
+
+        from dmlc_core_tpu import telemetry
+
+        def place(batch):
+            if telemetry.enabled():
+                telemetry.count("dmlc_transfer_bytes_total", 1)
+            return batch
+
+        def launch(batch):
+            return jax.jit(place)(batch)
+    """) == []
+
+
+def test_purity_telemetry_ungated_still_trips():
+    assert rules_of("""
+        import jax
+
+        from dmlc_core_tpu import telemetry
+
+        def place(batch):
+            telemetry.count("dmlc_transfer_bytes_total", 1)
+            return batch
+
+        def launch(batch):
+            return jax.jit(place)(batch)
+    """) == ["purity-telemetry-call"]
+
+
+def test_purity_foreign_enabled_gate_does_not_exempt():
+    assert rules_of("""
+        import jax
+
+        from dmlc_core_tpu import telemetry
+
+        def place(batch, feature):
+            if feature.enabled():
+                telemetry.count("dmlc_transfer_bytes_total", 1)
+            return batch
+
+        def launch(batch, feature):
+            return jax.jit(place)(batch, feature)
+    """) == ["purity-telemetry-call"]
+
+
+# -- rule catalog + driver wiring for passes 8/9 ------------------------------
+
+def test_cli_emit_rule_catalog(capsys):
+    assert main(["--emit-rule-catalog"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("| pass | rule | what it flags |")
+    for rule in ("escape-leak-on-raise", "escape-double-release",
+                 "jaxbound-unaccounted-transfer", "jaxbound-wide-wire",
+                 "jaxbound-jit-in-hot-path", "syntax"):
+        assert f"`{rule}`" in out
+
+
+def test_committed_rule_catalog_matches_code():
+    """docs/analysis.md's generated rule table must exactly reproduce
+    from the registered passes — the analyzer's own freshness contract."""
+    from dmlc_core_tpu.analysis.driver import render_rule_catalog
+
+    with open(os.path.join(REPO, "docs", "analysis.md"),
+              encoding="utf-8") as f:
+        doc = f.read()
+    for line in render_rule_catalog().splitlines():
+        assert line in doc, f"rule catalog drifted: {line}"
+
+
+def test_every_rule_belongs_to_exactly_one_pass():
+    from dmlc_core_tpu.analysis.driver import RULES_BY_PASS
+
+    owned = [r for rules in RULES_BY_PASS.values() for r in rules]
+    assert len(owned) == len(set(owned))
+    assert set(owned) | {"syntax"} == set(ALL_RULES)
+
+
+def test_cli_list_rules_has_pass8_and_9(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("escape-leak-on-raise", "escape-double-release",
+                 "jaxbound-unaccounted-transfer", "jaxbound-wide-wire",
+                 "jaxbound-jit-in-hot-path"):
+        assert rule in out
+
+
+def test_cli_pass_escape_and_jaxbound_standalone():
+    """`--pass escape,jaxbound` runs repo-wide and exits 0 on the
+    committed tree (the CI device-boundary step + the leak gate)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.analysis",
+         "--pass", "escape,jaxbound"],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_scoped_run_still_skips_new_project_passes(tmp_path, capsys):
+    pkg = _write_pkg(tmp_path, "print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    assert main([pkg, "--baseline", bl]) == 1
+    out = capsys.readouterr().out
+    assert "escape-" not in out and "jaxbound-" not in out
+
+
+def test_escape_os_close_twice_trips():
+    # raw-fd double close: the second close raises EBADF — or worse,
+    # closes an fd number the OS already reused for another handle
+    found = _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import os
+
+            def fsync_dir(path):
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                except OSError:
+                    os.close(fd)
+                os.close(fd)
+        """,
+    })
+    assert "escape-double-release" in [f.rule for f in found]
+
+
+def test_escape_os_close_in_finally_clean_twin():
+    # the page_cache.commit dir-fsync idiom
+    assert _escape_findings({
+        "dmlc_core_tpu/e.py": """
+            import os
+
+            def fsync_dir(path):
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+        """,
+    }) == []

@@ -269,3 +269,19 @@ def test_multiprocess_restart_recovery_broadcasts(tmp_path):
                                               "PHASE": phase})
         assert proc.returncode == 0, proc.stderr[-3000:]
     assert (tmp_path / "ok-0").exists() and (tmp_path / "ok-1").exists()
+
+
+def test_ring_allreduce_kernel_is_cached(mesh):
+    """dmlclint `jaxbound-jit-in-hot-path` regression: ring_allreduce used
+    to rebuild jax.jit(shard_map(...)) per call — empty compile cache,
+    full retrace every time."""
+    from dmlc_core_tpu.collective import mesh_collectives as mc
+
+    mc._RING_FNS.clear()
+    x = np.arange(8 * 8 * 2, dtype=np.float32).reshape(8 * 8, 2)
+    first = np.asarray(ring_allreduce(mesh, "data", jnp.asarray(x)))
+    assert len(mc._RING_FNS) == 1
+    fn = mc._RING_FNS[(mesh, "data")]
+    second = np.asarray(ring_allreduce(mesh, "data", jnp.asarray(x)))
+    assert mc._RING_FNS[(mesh, "data")] is fn  # cache hit, no rebuild
+    np.testing.assert_allclose(first, second)

@@ -261,3 +261,38 @@ def test_regex_uri(tmp_path):
     split = create_input_split(str(tmp_path / "x.*\\.txt"), 0, 1, "text",
                                threaded=False)
     assert collect_records(split) == [b"one", b"two"]
+
+
+# ------------------------------------------ constructor escape regression ---
+
+def test_cached_split_init_failure_closes_cache_file(tmp_path, monkeypatch):
+    """dmlclint `escape-leak-on-raise`: a failed ThreadedIter bring-up in
+    CachedInputSplit.__init__ must close the just-opened cache fd (no
+    caller ever holds the instance to close it)."""
+    import builtins
+
+    from dmlc_core_tpu.io import input_split as isplit
+
+    data = tmp_path / "d.txt"
+    data.write_text("a\nb\nc\n")
+    cache = str(tmp_path / "d.cache")
+
+    opened = []
+    real_open = builtins.open
+
+    def recording_open(*args, **kwargs):
+        fo = real_open(*args, **kwargs)
+        opened.append((args[0] if args else kwargs.get("file"), fo))
+        return fo
+
+    def exploding_iter(*args, **kwargs):
+        raise RuntimeError("injected producer bring-up failure")
+
+    monkeypatch.setattr(builtins, "open", recording_open)
+    monkeypatch.setattr(isplit, "ThreadedIter", exploding_iter)
+    base = LineSplitter(fsys.LocalFileSystem(), str(data), 0, 1)
+    with pytest.raises(RuntimeError, match="injected producer"):
+        CachedInputSplit(base, cache)
+    cache_fos = [fo for name, fo in opened if str(name) == cache]
+    assert cache_fos and all(fo.closed for fo in cache_fos)
+    base.close()

@@ -68,3 +68,28 @@ def test_mlp_sharded_step_runs():
                            data_sharding(mesh, ndim=1)))
         params, opt, loss = model.train_step(params, opt, batch)
     assert np.isfinite(float(loss))
+
+
+def test_predict_jit_fn_is_memoized(monkeypatch):
+    """dmlclint `jaxbound-jit-in-hot-path` regression: predict() used to
+    rebuild jax.jit(self._apply) — a fresh wrapper AND a fresh bound
+    method — on every call, so the compile cache never hit."""
+    param = MLPParam(num_feature=2, hidden="8", num_class=2,
+                     learning_rate=1e-3, bf16=False)
+    model = MLP(param)
+    params = model.init_params()
+
+    builds = []
+    real_jit = jax.jit
+
+    def counting_jit(*args, **kwargs):
+        builds.append(1)
+        return real_jit(*args, **kwargs)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+    x = np.zeros((3, 2), np.float32)
+    first = np.asarray(model.predict(params, x))
+    second = np.asarray(model.predict(params, x))
+    assert model._predict_fn() is model._predict_fn()
+    assert sum(builds) <= 1  # ONE wrapper serves every predict call
+    np.testing.assert_allclose(first, second)

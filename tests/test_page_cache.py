@@ -184,3 +184,70 @@ def test_empty_source_builds_empty_valid_cache(tmp_path):
     reader = page_cache.PageCacheReader(cache, np.uint32)
     assert reader.blocks == []
     reader.close()
+
+
+# ------------------------------------------ constructor escape regressions --
+# (dmlclint pass 8 `escape-leak-on-raise`: a failed __init__ orphans a
+# freshly-opened handle — the caller never gets the instance to close)
+
+def test_writer_init_failure_closes_fd_and_removes_tmp(tmp_path,
+                                                       monkeypatch):
+    import builtins
+
+    opened = []
+    real_open = builtins.open
+
+    def recording_open(*args, **kwargs):
+        fo = real_open(*args, **kwargs)
+        opened.append(fo)
+        return fo
+
+    def exploding_write(self, data):
+        raise OSError("injected disk-full")
+
+    monkeypatch.setattr(builtins, "open", recording_open)
+    monkeypatch.setattr(page_cache.PageCacheWriter, "_write",
+                        exploding_write)
+    cache = str(tmp_path / "c.cache")
+    with pytest.raises(OSError, match="injected disk-full"):
+        page_cache.PageCacheWriter(cache, np.uint32)
+    assert opened and opened[-1].closed
+    # no half-written temp file left behind either
+    assert [p for p in os.listdir(tmp_path) if ".tmp" in p] == []
+
+
+def test_reader_init_mmap_failure_closes_fd(tmp_path, monkeypatch):
+    import builtins
+    import types
+
+    # a real, valid v2 cache so the reader reaches the mmap
+    cache = str(tmp_path / "c.cache")
+    writer = page_cache.PageCacheWriter(cache, np.uint32)
+    container = RowBlockContainer(np.uint32)
+    parser = create_parser(_corpus(tmp_path, rows=50),
+                           part_index=0, num_parts=1, type="libsvm")
+    for block in parser:
+        container.push_block(block)
+        break
+    parser.close()
+    writer.write_page(container)
+    writer.commit()
+
+    opened = []
+    real_open = builtins.open
+
+    def recording_open(*args, **kwargs):
+        fo = real_open(*args, **kwargs)
+        opened.append(fo)
+        return fo
+
+    def exploding_mmap(*args, **kwargs):
+        raise OSError("injected mmap failure")
+
+    monkeypatch.setattr(builtins, "open", recording_open)
+    monkeypatch.setattr(
+        page_cache, "mmap",
+        types.SimpleNamespace(mmap=exploding_mmap, ACCESS_READ=0))
+    with pytest.raises(OSError, match="injected mmap failure"):
+        page_cache.PageCacheReader(cache, np.uint32)
+    assert opened and opened[-1].closed

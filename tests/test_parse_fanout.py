@@ -318,3 +318,93 @@ def test_same_parser_self_heals_after_worker_death(tmp_path, monkeypatch):
         assert sum(b.size for b in parser) == 500
     finally:
         parser.close()
+
+
+# ------------------------------------------ exception-path lease escapes ----
+# (dmlclint pass 8 `escape-leak-on-raise` surfaced both of these; each fix
+# gets its regression test here, in the style of the PR 4 shm-lease fixes)
+
+def test_worker_parse_unlinks_segment_when_copy_fails(monkeypatch):
+    """A failure while filling the worker-side segment must unlink it:
+    pre-fix, the consumer never learned the name and the bytes sat in
+    /dev/shm until reboot."""
+    from multiprocessing import shared_memory
+
+    created = []
+    real_shm = shared_memory.SharedMemory
+
+    class _ExplodingBuf:
+        def __init__(self, seg):
+            self._seg = seg
+            self.name = seg.name
+
+        @property
+        def buf(self):
+            raise RuntimeError("injected copy failure")
+
+        def close(self):
+            self._seg.close()
+
+        def unlink(self):
+            self._seg.unlink()
+
+    def exploding(*args, **kwargs):
+        seg = real_shm(*args, **kwargs)
+        created.append(seg.name)
+        return _ExplodingBuf(seg)
+
+    monkeypatch.setattr(parse_proc.shared_memory, "SharedMemory", exploding)
+    spec = ("dmlc_core_tpu.data.libsvm_parser", "LibSVMParser",
+            {"nthread": 1, "index_dtype": "<u4"})
+    with pytest.raises(RuntimeError, match="injected copy failure"):
+        parse_proc._worker_parse(spec, b"1 0:1.5 3:2.5\n0 1:0.5\n")
+    assert len(created) == 1
+    # the segment name must be gone: attaching by name has to fail
+    with pytest.raises(FileNotFoundError):
+        real_shm(name=created[0])
+
+
+def test_attach_block_releases_mapping_when_wrapping_fails(monkeypatch):
+    """attach_block steals the mapping from the SharedMemory object
+    BEFORE registering the finalizer; a failure in that window must
+    release the stolen mapping itself — and with telemetry enabled the
+    release must carry the already-incremented gauge delta, or the
+    in-flight series drifts upward for the life of the process."""
+    spec = ("dmlc_core_tpu.data.libsvm_parser", "LibSVMParser",
+            {"nthread": 1, "index_dtype": "<u4"})
+    meta = parse_proc._worker_parse(spec, b"1 0:1.5 3:2.5\n0 1:0.5\n")
+    assert meta["shm"] and meta["nbytes"] > 0
+
+    released = []
+    gauge_deltas = []
+    real_release = parse_proc._release_lease
+    real_telemetry = parse_proc.telemetry
+
+    def recording_release(mm, buf, gauge_bytes):
+        released.append(gauge_bytes)
+        real_release(mm, buf, gauge_bytes)
+
+    class _Telemetry:
+        @staticmethod
+        def enabled():
+            return True
+
+        @staticmethod
+        def gauge_add(name, delta, **labels):
+            gauge_deltas.append(delta)
+
+        def __getattr__(self, name):
+            return getattr(real_telemetry, name)
+
+    def exploding_finalize(*args, **kwargs):
+        raise RuntimeError("injected finalize failure")
+
+    monkeypatch.setattr(parse_proc, "_release_lease", recording_release)
+    monkeypatch.setattr(parse_proc, "telemetry", _Telemetry())
+    monkeypatch.setattr(parse_proc.weakref, "finalize", exploding_finalize)
+    with pytest.raises(RuntimeError, match="injected finalize failure"):
+        parse_proc.attach_block(meta, np.uint32)
+    # the error path released the stolen mapping with the FULL delta...
+    assert released == [meta["nbytes"]]
+    # ...so the gauge increments and decrements balance to zero
+    assert sum(gauge_deltas) == 0

@@ -580,3 +580,108 @@ def test_collective_uneven_device_counts(tmp_path):
     """Ranks owning different device counts (3 vs 1): stride arithmetic over
     a process-major device order would gather/broadcast the wrong shards."""
     _run_collective_workers(tmp_path, 2, dev_counts="3,1")
+
+
+# ------------------------------------------ exception-path socket escapes ---
+# (dmlclint pass 8 `escape-leak-on-raise` regressions: each hand-verified
+# leak fix gets its own test)
+
+def test_default_host_ip_closes_probe_socket_on_connect_failure(monkeypatch):
+    """Pre-fix, connect() raising OSError jumped past s.close() straight
+    into the handler — one leaked UDP socket per call on offline hosts."""
+    from dmlc_core_tpu.tracker import submit as submit_mod
+
+    probes = []
+    real_socket = socket.socket
+
+    class _Recorder(socket.socket):
+        def connect(self, addr):
+            raise OSError("network unreachable")
+
+    def make(*args, **kwargs):
+        s = _Recorder(*args, **kwargs)
+        probes.append(s)
+        return s
+
+    monkeypatch.setattr(submit_mod.socket, "socket", make)
+    assert submit_mod._default_host_ip() == "127.0.0.1"
+    assert probes and all(p.fileno() == -1 for p in probes)  # closed
+    monkeypatch.setattr(submit_mod.socket, "socket", real_socket)
+
+
+def test_print_command_connection_closed_by_tracker():
+    """The print path used to drop the accepted fd on the floor (one
+    leaked fd per print message until GC)."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    try:
+        s = socket.socket()
+        s.connect(("127.0.0.1", tracker.port))
+        fs = FramedSocket(s)
+        fs.sendint(MAGIC)
+        assert fs.recvint() == MAGIC
+        fs.sendint(-1)
+        fs.sendint(-1)
+        fs.sendstr("NULL")
+        fs.sendstr("print")
+        fs.sendstr("fd hygiene")
+        s.settimeout(10)
+        assert s.recv(1) == b""   # tracker closed its end after logging
+        s.close()
+    finally:
+        c = FakeRabitClient("127.0.0.1", tracker.port)
+        threading.Thread(target=c.start, daemon=True).start()
+        time.sleep(0.3)
+        c.shutdown()
+        tracker.join(timeout=10)
+
+
+def test_tracker_init_closes_socket_when_listen_fails(monkeypatch):
+    """A constructor failure after bind_free_port must close the bound
+    socket: the caller never receives the tracker instance."""
+    from dmlc_core_tpu.tracker import rendezvous as rz
+
+    class _Sock:
+        def __init__(self):
+            self.closed = False
+
+        def listen(self, n):
+            raise OSError("injected listen failure")
+
+        def close(self):
+            self.closed = True
+
+    sock = _Sock()
+    monkeypatch.setattr(rz, "bind_free_port", lambda *a, **k: (sock, 9191))
+    with pytest.raises(OSError, match="injected listen failure"):
+        RabitTracker("127.0.0.1", 1)
+    assert sock.closed
+
+
+def test_local_submit_cleans_job_dir_when_staging_fails(tmp_path,
+                                                        monkeypatch):
+    """Pre-fix the staged job dir's only cleanup lived in fun_submit's
+    finally — a nested def the staging-failure path never runs."""
+    import tempfile
+
+    from dmlc_core_tpu.tracker import local as local_mod
+
+    made = []
+    real_mkdtemp = tempfile.mkdtemp
+
+    def recording_mkdtemp(*args, **kwargs):
+        d = real_mkdtemp(*args, **kwargs)
+        made.append(d)
+        return d
+
+    def exploding_stage(files, archives, dest):
+        raise RuntimeError("injected staging failure")
+
+    monkeypatch.setattr(local_mod.tempfile, "mkdtemp", recording_mkdtemp)
+    monkeypatch.setattr(local_mod, "prepare_shipping",
+                        lambda opts: ({}, ["true"], ["f.txt"], []))
+    monkeypatch.setattr(local_mod, "stage_job_dir", exploding_stage)
+    opts = get_opts(["--cluster", "local", "--num-workers", "1", "true"])
+    with pytest.raises(RuntimeError, match="injected staging failure"):
+        local_mod.submit(opts)
+    assert made and not os.path.exists(made[0])
