@@ -5,6 +5,7 @@ split_read_test.cc, libsvm_parser_test.cc — they print MB/sec).
     python benchmarks/bench_pipeline.py split  <uri> [part] [nparts] [type]
     python benchmarks/bench_pipeline.py parser <uri> [format] [nthread]
     python benchmarks/bench_pipeline.py parser-ab <uri> [format] [out.json] [workers]
+    python benchmarks/bench_pipeline.py cache-ab [rows] [out.json] [trace_dir]
     python benchmarks/bench_pipeline.py gen    <path> [rows] [features] [libsvm|libfm|csv]
     python benchmarks/bench_pipeline.py genrec <path.rec> [records] [bytes]
     python benchmarks/bench_pipeline.py infeed <path.rec> [record_bytes] [batch]
@@ -15,6 +16,16 @@ single-worker, thread-pool, and process-pool (DMLC_PARSE_PROC) backends,
 prints rows/s per stage (raw split read vs parse), and writes the JSON
 record next to the telemetry artifact in CI (and into
 benchmarks/results/ when run by hand).
+
+``cache-ab`` is the fleet-shared remote page cache A/B on a loopback
+mock-S3 store: worker A cold-parses the remote corpus, builds the v2
+cache, and publishes it (``DMLC_CACHE_REMOTE=1``); worker B — a fresh
+"host" (its own ``DMLC_CACHE_LOCAL_DIR``) — fetches the published cache
+through the ranged-read layer instead of re-parsing.  Prints rows/s per
+stage, verifies the warm path actually engaged (a silent
+fallback-to-parse exits nonzero rather than logging parse numbers as
+cache numbers), and assembles the ``cache.fetch``/``cache.publish``
+spans into a merged trace with the critical-path CLI.
 """
 
 import os
@@ -158,6 +169,128 @@ def bench_parser_ab(uri, fmt="auto", out_json=None, workers=None):
     return results
 
 
+def bench_cache_ab(rows=400_000, out_json=None, trace_dir=None):
+    """Cold-remote parse vs warm fleet-fetched cache on a loopback store.
+
+    Exits nonzero when the warm path silently falls back to stream-parsing
+    — a fallback's parse throughput recorded as a "cache fetch" number
+    would poison the longitudinal series (and is exactly the failure the
+    CI cache-bench job exists to catch)."""
+    import json
+    import tempfile
+    import time as _time
+
+    from dmlc_core_tpu import telemetry
+
+    rows = int(rows)
+    work = tempfile.mkdtemp(prefix="cache-ab-")
+    trace_dir = trace_dir or os.path.join(work, "trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    telemetry.enable(trace_dir)
+
+    src = os.path.join(work, "data.libsvm")
+    gen(src, rows=rows, features=28, fmt="libsvm")
+    corpus_bytes = os.path.getsize(src)
+
+    from tests.mock_s3 import MockS3
+
+    server = MockS3().start()
+    os.environ.update(AWS_ACCESS_KEY_ID="cache-ab",
+                      AWS_SECRET_ACCESS_KEY="cache-ab",
+                      AWS_REGION="us-east-1",
+                      S3_ENDPOINT=f"http://127.0.0.1:{server.port}")
+    with open(src, "rb") as f:
+        server.objects[("bucket", "data.libsvm")] = f.read()
+
+    from dmlc_core_tpu.data.factory import create_row_block_iter
+
+    uri = "s3://bucket/data.libsvm#s3://bucket/caches/data.rbc"
+    reg = telemetry.get_registry()
+    hits = reg.counter("dmlc_cache_remote_hits_total")
+    publishes = reg.counter("dmlc_cache_remote_publishes_total")
+    rebuilds = reg.counter("dmlc_cache_rebuilds_total")
+    fetched = reg.counter("dmlc_cache_remote_bytes_fetched_total")
+
+    def one_worker(stage, host_dir):
+        """One fleet worker: iterator construction (where the fetch or the
+        parse+build+publish happens) plus a full epoch drain, timed as one
+        stage — then a second epoch alone, the steady-state mmap number."""
+        os.environ["DMLC_CACHE_LOCAL_DIR"] = host_dir
+        with telemetry.span(f"cache_ab.{stage}", rows=rows):
+            t0 = _time.perf_counter()
+            it = create_row_block_iter(uri, type="libsvm")
+            got = sum(b.size for b in it)
+            elapsed = _time.perf_counter() - t0
+        it.before_first()
+        t0 = _time.perf_counter()
+        got2 = sum(b.size for b in it)
+        epoch2 = _time.perf_counter() - t0
+        it.close()
+        assert got == got2 == rows, f"{stage}: {got}/{got2} of {rows} rows"
+        return elapsed, epoch2
+
+    # page granularity is the fetch-pipeline unit: 8 MB pages give the
+    # prefetch ring several in-flight ranged reads to overlap (one default
+    # 64 MB page would serialize the whole warm fetch behind one request).
+    # Depth 2 on the LOOPBACK store: client, server, and CRC share one
+    # host's cores, so two streams already saturate it — the deeper
+    # default ring is sized for real object stores with per-stream caps
+    os.environ.setdefault("DMLC_CACHE_PAGE_BYTES", str(8 << 20))
+    os.environ.setdefault("DMLC_CACHE_PREFETCH", "2")
+    os.environ["DMLC_CACHE_REMOTE"] = "1"
+    try:
+        cold_s, cold_epoch2_s = one_worker("cold", os.path.join(work, "host-a"))
+        cold_published = publishes.value >= 1
+        warm_s, warm_epoch2_s = one_worker("warm", os.path.join(work, "host-b"))
+        warm_engaged = (hits.value >= 1 and cold_published
+                        and rebuilds.value == 0)
+    finally:
+        server.stop()
+        os.environ.pop("DMLC_CACHE_REMOTE", None)
+        os.environ.pop("DMLC_CACHE_LOCAL_DIR", None)
+
+    results = {
+        "rows": rows, "corpus_bytes": corpus_bytes,
+        "remote_cache_bytes": int(fetched.value),
+        "warm_fetch_engaged": warm_engaged,
+        "stages": {
+            "cold_parse_build_publish": {
+                "seconds": cold_s, "rows_per_s": rows / max(cold_s, 1e-9)},
+            "warm_fleet_fetch": {
+                "seconds": warm_s, "rows_per_s": rows / max(warm_s, 1e-9)},
+            "cold_epoch2_mmap": {
+                "seconds": cold_epoch2_s,
+                "rows_per_s": rows / max(cold_epoch2_s, 1e-9)},
+            "warm_epoch2_mmap": {
+                "seconds": warm_epoch2_s,
+                "rows_per_s": rows / max(warm_epoch2_s, 1e-9)},
+        },
+        "warm_vs_cold_speedup": cold_s / max(warm_s, 1e-9),
+    }
+    print(f"{'stage':>26}  {'rows/s':>12}  {'seconds':>8}")
+    for name, st in results["stages"].items():
+        print(f"{name:>26}  {st['rows_per_s']:>12.0f}  {st['seconds']:>8.2f}")
+    print(f"warm fleet fetch vs cold re-parse: "
+          f"{results['warm_vs_cold_speedup']:.2f}x")
+
+    telemetry.flush(trace_dir)
+    from dmlc_core_tpu.telemetry import traceview
+
+    merged = os.path.join(trace_dir, "merged.trace.json")
+    traceview.main(trace_dir, out=merged, as_json=False, top=10)
+    results["merged_trace"] = merged
+    if out_json:
+        with open(out_json, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {out_json}")
+    if not warm_engaged:
+        print("ERROR: warm fetch path did NOT engage — the 'warm' number "
+              "above is a stream-parse fallback, not a cache fetch",
+              file=sys.stderr)
+        raise SystemExit(1)
+    return results
+
+
 def gen(path, rows=1_000_000, features=28, fmt="libsvm"):
     """Synthetic HIGGS-like text file for benchmarking.
 
@@ -292,12 +425,12 @@ def bench_infeed(uri, record_bytes=600, batch=256):
 
 
 def main():
-    if len(sys.argv) < 3:
-        print(__doc__)
-        return 2
+    if len(sys.argv) < 3 and sys.argv[1:] != ["cache-ab"]:
+        print(__doc__)   # cache-ab is self-contained; everything else
+        return 2         # needs at least a URI/path argument
     cmd, args = sys.argv[1], sys.argv[2:]
     {"split": bench_split, "parser": bench_parser,
-     "parser-ab": bench_parser_ab, "gen": gen,
+     "parser-ab": bench_parser_ab, "cache-ab": bench_cache_ab, "gen": gen,
      "genrec": genrec, "infeed": bench_infeed}[cmd](*args)
     return 0
 

@@ -84,8 +84,14 @@ def create_row_block_iter(
     spec = URISpec(uri, part_index, num_parts)
     parser_uri = spec.uri + ("?" + "&".join(f"{k}={v}" for k, v in spec.args.items())
                              if spec.args else "")
+    if spec.cache_file:
+        # lazily: a warm cache (local materialization or a fleet-shared
+        # remote fetch) serves without ever constructing the parser or its
+        # input split — no stream opens, no remote stat/list traffic
+        return DiskRowIter(
+            lambda: create_parser(parser_uri, part_index, num_parts, type,
+                                  nthread, index_dtype),
+            spec.cache_file, index_dtype=index_dtype)
     parser = create_parser(parser_uri, part_index, num_parts, type, nthread,
                            index_dtype)
-    if spec.cache_file:
-        return DiskRowIter(parser, spec.cache_file, index_dtype=index_dtype)
     return BasicRowIter(parser, index_dtype=index_dtype)

@@ -17,6 +17,8 @@ Injection sites (see :data:`SITES`):
   (``http_status`` rules replace the request; act rules fire before it);
 - ``io.stream.open``       — URI stream factory open;
 - ``io.stream.read``       — :meth:`Stream.read_exact` (``truncate`` rules);
+- ``io.cache.fetch``       — remote page-cache ranged reads
+  (:func:`dmlc_core_tpu.data.page_cache.fetch_remote_cache`);
 - ``threadediter.produce`` — the producer thread, per item;
 - ``data.parse_worker``    — process-pool parse workers, per sub-range
   (``exit`` = kill a worker mid-chunk);
@@ -80,6 +82,11 @@ SITES: Dict[str, str] = {
     "io.stream.read": (
         "Stream.read_exact; 'truncate' cuts the stream short, modeling a "
         "truncated object/dropped connection"),
+    "io.cache.fetch": (
+        "remote page-cache ranged reads (ctx: uri=<uri>, offset=<byte "
+        "offset>), once per header/TOC/page fetch; 'truncate' cuts a page "
+        "short and 'reset'/'error' kill the transfer — every outcome must "
+        "end in a loud stream-parse fallback, never a served bad page"),
     "threadediter.produce": (
         "producer thread, once per produced item (ctx: name=<iterator>)"),
     "data.parse_worker": (

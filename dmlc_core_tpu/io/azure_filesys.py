@@ -189,6 +189,14 @@ class _AzureWriteStream(Stream):
                              body=block)
         self._block_ids.append(block_id)
 
+    def abort(self) -> None:
+        """Abandon without committing: nothing lands at the path (Put Block
+        List in :meth:`close` is the commit point); uncommitted blocks are
+        garbage-collected by the service after a week."""
+        self._closed = True
+        self._buffer.clear()
+        self._block_ids.clear()
+
     def close(self) -> None:
         if self._closed:
             return

@@ -192,5 +192,8 @@ class LocalFileSystem(FileSystem):
     def open_for_read(self, path: URI) -> SeekStream:
         return _LocalFileStream(open(path.name, "rb"))
 
+    def delete(self, path: URI) -> None:
+        os.unlink(path.name)
+
 
 _fs_registry.add("file", LocalFileSystem, description="local disk (default protocol)")

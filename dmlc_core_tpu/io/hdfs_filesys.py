@@ -98,6 +98,11 @@ class HDFSFileSystem(fsys.FileSystem):
             return _ArrowStream(hdfs.open_output_stream(path.name), True)
         return _ArrowStream(hdfs.open_append_stream(path.name), True)
 
+    def delete(self, path: fsys.URI) -> None:
+        # hdfs writes stream THROUGH to the target (no abort/commit point),
+        # so abandoning a half-written file means deleting it
+        _arrow_fs(path).delete_file(path.name)
+
     def open_for_read(self, path: fsys.URI) -> SeekStream:
         hdfs = _arrow_fs(path)
         return _ArrowStream(hdfs.open_input_file(path.name), False)

@@ -1158,6 +1158,18 @@ def _native_split_setup(fs: fsys.FileSystem, uri: str, format: str):
     return files, extract, adapter
 
 
+def _native_ring(adapter) -> int:
+    """Native prefetch-ring depth: the classic double buffer locally, a
+    deeper pre-posted ring on the remote callback path so one batched
+    ``next_chunks`` crossing amortizes the Python↔C round-trip over
+    everything the ring buffered (VERDICT item 6; ``DMLC_NATIVE_RING``
+    overrides either default)."""
+    from dmlc_core_tpu.param import get_env
+
+    return max(2, get_env("DMLC_NATIVE_RING", int,
+                          2 if adapter is None else 8))
+
+
 class NativeLineSplitter(InputSplit):
     """C++ split engine with built-in prefetch (native/input_split.cc).
 
@@ -1183,7 +1195,7 @@ class NativeLineSplitter(InputSplit):
             [info.path.name for info in files],
             [info.size for info in files], part_index, num_parts,
             buffer_size=self._buffer_size, format=format,
-            read_at=self._adapter)
+            read_at=self._adapter, ring=_native_ring(self._adapter))
         self._cursor = ChunkCursor()
 
     def before_first(self) -> None:
@@ -1250,7 +1262,8 @@ class NativeCachedSplitter(InputSplit):
         self._native = native_bridge.NativeLineSplit(
             [info.path.name for info in files],
             [info.size for info in files], part_index, num_parts,
-            format=format, read_at=self._adapter, cache_path=cache_file)
+            format=format, read_at=self._adapter, cache_path=cache_file,
+            ring=_native_ring(self._adapter))
         self._total = self._native.total_size()
         self._replay = None
         self._at_end = False   # replay exhausted (or just swapped in)

@@ -235,6 +235,28 @@ class S3WriteStream(Stream):
             body=part)
         self._etags.append(headers.get("etag", ""))
 
+    def abort(self) -> None:
+        """Abandon the upload WITHOUT committing — nothing lands at the key.
+
+        :meth:`close` is the commit point (CompleteMultipartUpload, or the
+        small-object PUT), so error paths must call this instead: completing
+        a partial upload would land a truncated object for every reader to
+        trip over.  Best-effort AbortMultipartUpload frees the parts already
+        uploaded; an orphaned upload id only costs storage until the
+        bucket's abort-incomplete-uploads lifecycle rule."""
+        if self._closed:
+            return
+        self._closed = True
+        self._buffer.clear()
+        if self._upload_id is not None:
+            try:
+                self._client.request(
+                    "DELETE", self._key,
+                    query={"uploadId": self._upload_id},
+                    ok=(200, 204, 404))  # 404: already expired/reconciled
+            except Exception:
+                pass
+
     def close(self) -> None:
         if self._closed:
             return

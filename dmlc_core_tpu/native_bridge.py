@@ -55,7 +55,7 @@ def _load() -> Optional[ctypes.CDLL]:
         # ABI handshake: a stale build with old entry-point signatures must
         # not be called through mismatched ctypes prototypes — rebuild once,
         # and disable the native path if the rebuild still disagrees
-        _ABI = 4
+        _ABI = 5
         ver_fn = getattr(lib, "dmlc_tpu_abi_version", None)
         if ver_fn is None or int(ver_fn()) != _ABI:
             del lib
@@ -347,7 +347,8 @@ def _load_lsplit():
         lib.dmlc_tpu_rsplit_open.argtypes = open_sig
         lib.dmlc_tpu_lsplit_open2.restype = ctypes.c_void_p
         lib.dmlc_tpu_lsplit_open2.argtypes = open_sig + [
-            ctypes.c_int64, ctypes.c_char_p, READ_AT_FN, ctypes.c_void_p]
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p, READ_AT_FN,
+            ctypes.c_void_p]
         lib.dmlc_tpu_lsplit_finish_cache.restype = ctypes.c_int64
         lib.dmlc_tpu_lsplit_finish_cache.argtypes = [ctypes.c_void_p]
         lib.dmlc_tpu_creplay_open.restype = ctypes.c_void_p
@@ -382,6 +383,10 @@ def _load_lsplit():
         lib.dmlc_tpu_lsplit_next_chunk.restype = ctypes.c_int64
         lib.dmlc_tpu_lsplit_next_chunk.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+        lib.dmlc_tpu_lsplit_next_chunks.restype = ctypes.c_int64
+        lib.dmlc_tpu_lsplit_next_chunks.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
         lib.dmlc_tpu_lsplit_error.restype = ctypes.c_char_p
         lib.dmlc_tpu_lsplit_error.argtypes = [ctypes.c_void_p]
         lib.dmlc_tpu_lsplit_close.argtypes = [ctypes.c_void_p]
@@ -413,14 +418,28 @@ class NativeLineSplit:
     reads through Python — the remote-filesystem path; ``cache_path``
     tees epoch-1 chunks into a cache file (``finish_cache`` closes it,
     :class:`NativeCacheReplay` replays it).
+
+    ``ring`` is the native prefetch-queue depth.  2 is the classic double
+    buffer; deeper rings pre-post more read-ahead AND switch the consumer
+    to the batched ``next_chunks`` pop — one Python↔C crossing (one GIL
+    round-trip) amortizes over everything the ring had buffered, the
+    VERDICT item-6 fix for the per-chunk crossing tax on the remote
+    callback path.
     """
 
     def __init__(self, paths, sizes, part: int, nparts: int,
                  buffer_size: int = 8 << 20, format: str = "line",
-                 read_at=None, cache_path: Optional[str] = None):
+                 read_at=None, cache_path: Optional[str] = None,
+                 ring: int = 2):
         lib = _load_lsplit()
         assert lib is not None
         self._lib = lib
+        self._ring = max(2, int(ring))
+        # batched-pop state: arrays the C side fills in one crossing, and
+        # the views already handed back from the last fill (addr, len)
+        self._batch_ptrs = (ctypes.c_char_p * self._ring)()
+        self._batch_lens = (ctypes.c_int64 * self._ring)()
+        self._pending: list = []
         blob, lens, arr = _encode_files(paths, sizes)
         # the CFUNCTYPE object must outlive the handle (the prefetch thread
         # calls through it); keep the reference on self
@@ -429,7 +448,7 @@ class NativeLineSplit:
         self._read_at = read_at
         self._handle = lib.dmlc_tpu_lsplit_open2(
             blob, lens, arr, len(sizes), part, nparts, buffer_size,
-            1 if format == "recordio" else 0,
+            1 if format == "recordio" else 0, self._ring,
             cache_path.encode() if cache_path else None,
             self._read_at if self._read_at is not None
             else ctypes.cast(None, READ_AT_FN), None)
@@ -455,6 +474,7 @@ class NativeLineSplit:
         return self._lib.dmlc_tpu_lsplit_total(self._require_open())
 
     def reset(self, part: int, nparts: int) -> None:
+        self._pending.clear()   # views into pre-reset chunks are stale
         self._lib.dmlc_tpu_lsplit_reset(self._require_open(), part, nparts)
         self._check()
 
@@ -469,9 +489,29 @@ class NativeLineSplit:
         return ctypes.string_at(*view)
 
     def next_chunk_view(self):
-        """Zero-copy ``(addr, len)`` over the next chunk — valid until the
-        next call on this handle (the parser fast path consumes it in
-        place before popping again)."""
+        """Zero-copy ``(addr, len)`` over the next chunk — valid at least
+        until the crossing after the batch it came from drains (with the
+        default ``ring=2``: until the next call, the classic contract; the
+        parser fast path consumes it in place before popping again).
+
+        With ``ring > 2`` one batched ``next_chunks`` crossing drains
+        everything the native ring had buffered and later calls serve from
+        that batch without touching the GIL/ctypes boundary."""
+        if self._ring > 2:
+            if self._pending:
+                return self._pending.pop(0)
+            n = self._lib.dmlc_tpu_lsplit_next_chunks(
+                self._require_open(), self._batch_ptrs, self._batch_lens,
+                self._ring)
+            if n < 0:
+                self._check()
+            if n <= 0:
+                return None
+            ptrs = ctypes.cast(self._batch_ptrs,
+                               ctypes.POINTER(ctypes.c_void_p))
+            self._pending = [(ptrs[i], self._batch_lens[i])
+                             for i in range(n)]
+            return self._pending.pop(0)
         ptr = ctypes.c_char_p()
         n = self._lib.dmlc_tpu_lsplit_next_chunk(self._require_open(),
                                                  ctypes.byref(ptr))
@@ -482,6 +522,7 @@ class NativeLineSplit:
         return ctypes.cast(ptr, ctypes.c_void_p).value, n
 
     def close(self) -> None:
+        self._pending.clear()   # batched views die with the handle
         if self._handle is not None:
             self._lib.dmlc_tpu_lsplit_close(self._handle)
             self._handle = None

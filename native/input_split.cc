@@ -201,13 +201,19 @@ inline uint64_t DecodeU64LE(const unsigned char *in) {
   return v;
 }
 
-// Shared double-buffered prefetch: one producer thread, queue capacity 2,
-// (ok, chunk) items with an end sentinel that stays queued for repeated
-// pops (reference threaded_input_split.h:23-101 / ThreadedIter cap-2).
+// Shared prefetch ring: one producer thread, queue capacity 2 by default
+// (reference threaded_input_split.h:23-101 / ThreadedIter cap-2) or a
+// deeper pre-posted ring for the batched-pop remote path, (ok, chunk)
+// items with an end sentinel that stays queued for repeated pops.
 // Used by both split engines so the protocol can't drift between them.
 class PrefetchQueue {
  public:
   ~PrefetchQueue() { Stop(); }
+
+  // only before Start(): the ring depth the producer fills ahead
+  void SetCapacity(int64_t capacity) {
+    capacity_ = capacity < 1 ? 1 : static_cast<size_t>(capacity);
+  }
 
   // next(chunk) -> true while chunks remain; false terminates the producer
   void Start(std::function<bool(std::vector<char> *)> next) {
@@ -217,7 +223,8 @@ class PrefetchQueue {
         std::vector<char> chunk;
         bool ok = next(&chunk);
         std::unique_lock<std::mutex> lk(mu_);
-        cv_space_.wait(lk, [this] { return queue_.size() < 2 || stop_; });
+        cv_space_.wait(lk,
+                       [this] { return queue_.size() < capacity_ || stop_; });
         if (stop_) return;
         queue_.emplace_back(ok, std::move(chunk));
         cv_data_.notify_one();
@@ -259,11 +266,30 @@ class PrefetchQueue {
     return true;
   }
 
+  // batched pop: block for the first chunk, then drain whatever else is
+  // already buffered (never waiting on the producer) up to `cap` — one
+  // consumer crossing amortizes over everything the ring had ready.
+  // Returns the number popped; 0 = end of data (sentinel stays queued).
+  int64_t PopMany(std::vector<std::vector<char>> *out, int64_t cap) {
+    out->clear();
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_data_.wait(lk, [this] { return !queue_.empty(); });
+    while (!queue_.empty() && static_cast<int64_t>(out->size()) < cap) {
+      auto &item = queue_.front();
+      if (!item.first) break;  // sentinel: stays queued for the next call
+      out->push_back(std::move(item.second));
+      queue_.pop_front();
+      cv_space_.notify_one();
+    }
+    return static_cast<int64_t>(out->size());
+  }
+
  private:
   std::thread producer_;
   std::mutex mu_;
   std::condition_variable cv_data_, cv_space_;
   std::deque<std::pair<bool, std::vector<char>>> queue_;
+  size_t capacity_ = 2;
   bool stop_ = false;
 };
 
@@ -272,8 +298,9 @@ class LineSplitEngine {
   LineSplitEngine(std::vector<FileEnt> files, int64_t buffer_size,
                   Format format = kLine,
                   dmlc_tpu_read_at_fn read_cb = nullptr, void *ctx = nullptr,
-                  const char *cache_path = nullptr)
+                  const char *cache_path = nullptr, int64_t ring = 2)
       : files_(std::move(files)), buffer_size_(buffer_size), format_(format) {
+    queue_.SetCapacity(ring);
     offsets_.push_back(0);
     for (auto &f : files_) offsets_.push_back(offsets_.back() + f.size);
     src_ = MakeSource(files_, read_cb, ctx);
@@ -381,6 +408,11 @@ class LineSplitEngine {
 
   // pops the next prefetched chunk; false at end
   bool PopChunk(std::vector<char> *out) { return queue_.Pop(out); }
+
+  // pops up to `cap` buffered chunks in one call (see PrefetchQueue::PopMany)
+  int64_t PopChunks(std::vector<std::vector<char>> *out, int64_t cap) {
+    return queue_.PopMany(out, cap);
+  }
 
   // error_ is written by the prefetch thread (Fail in Read/OpenFile) and
   // read by the consumer thread — guard it with its own mutex so a torn
@@ -770,6 +802,10 @@ class SpanReadEngine {
 struct SplitHandle {
   LineSplitEngine *engine = nullptr;
   std::vector<char> current;  // chunk handed to Python, valid until next call
+  // batched-pop storage: every chunk of the last next_chunks stays valid
+  // until the NEXT next_chunk/next_chunks call, so the Python side can
+  // hand out views one at a time without re-crossing
+  std::vector<std::vector<char>> batch;
   std::string error;
 };
 
@@ -804,6 +840,8 @@ extern "C" {
 // paths: concatenated path bytes with per-path byte lengths in path_lens
 // (length-delimited, so any legal filename byte — incl. '\n' — is safe);
 // sizes: per-file byte sizes.  format: 0 = line, 1 = recordio.
+// ring: prefetch-queue depth (2 = the classic double buffer; deeper rings
+// feed the batched next_chunks pop on the remote callback path).
 // read_cb/ctx: non-null routes ALL byte reads through the callback (remote
 // filesystems); cache_path: non-empty tees epoch-1 chunks into a cache file
 // (finish with dmlc_tpu_lsplit_finish_cache, replay with creplay_*).
@@ -811,12 +849,12 @@ void *dmlc_tpu_lsplit_open2(const char *paths, const int64_t *path_lens,
                             const int64_t *sizes, int64_t nfiles,
                             int64_t part, int64_t nparts,
                             int64_t buffer_size, int64_t format,
-                            const char *cache_path,
+                            int64_t ring, const char *cache_path,
                             dmlc_tpu_read_at_fn read_cb, void *ctx) {
   auto *h = new SplitHandle();
   h->engine = new LineSplitEngine(
       DecodeFiles(paths, path_lens, sizes, nfiles), buffer_size,
-      format == 1 ? kRecordIO : kLine, read_cb, ctx, cache_path);
+      format == 1 ? kRecordIO : kLine, read_cb, ctx, cache_path, ring);
   h->engine->ResetPartition(part, nparts);
   if (h->engine->failed()) h->error = h->engine->Error();
   return h;
@@ -827,7 +865,7 @@ void *dmlc_tpu_lsplit_open(const char *paths, const int64_t *path_lens,
                            int64_t part, int64_t nparts,
                            int64_t buffer_size) {
   return dmlc_tpu_lsplit_open2(paths, path_lens, sizes, nfiles, part, nparts,
-                               buffer_size, 0, nullptr, nullptr, nullptr);
+                               buffer_size, 0, 2, nullptr, nullptr, nullptr);
 }
 
 // RecordIO variant: same handle/call surface as lsplit_* (hint/total/reset/
@@ -837,7 +875,7 @@ void *dmlc_tpu_rsplit_open(const char *paths, const int64_t *path_lens,
                            int64_t part, int64_t nparts,
                            int64_t buffer_size) {
   return dmlc_tpu_lsplit_open2(paths, path_lens, sizes, nfiles, part, nparts,
-                               buffer_size, 1, nullptr, nullptr, nullptr);
+                               buffer_size, 1, 2, nullptr, nullptr, nullptr);
 }
 
 // drain the remaining partition through the cache tee and close the cache
@@ -965,6 +1003,24 @@ int64_t dmlc_tpu_lsplit_next_chunk(void *handle, const char **ptr) {
   if (h->engine->failed()) { h->error = h->engine->Error(); return -1; }
   *ptr = h->current.data();
   return static_cast<int64_t>(h->current.size());
+}
+
+// batched pop: up to `cap` chunks in ONE Python->C crossing — blocks for
+// the first chunk, then drains whatever the prefetch ring already buffered.
+// Fills ptrs[i]/lens[i]; every view stays valid until the next
+// next_chunk/next_chunks call on this handle.  Returns the count popped,
+// 0 at partition end, -1 on error.
+int64_t dmlc_tpu_lsplit_next_chunks(void *handle, const char **ptrs,
+                                    int64_t *lens, int64_t cap) {
+  auto *h = static_cast<SplitHandle *>(handle);
+  if (!h->error.empty()) return -1;
+  int64_t n = h->engine->PopChunks(&h->batch, cap);
+  if (h->engine->failed()) { h->error = h->engine->Error(); return -1; }
+  for (int64_t i = 0; i < n; ++i) {
+    ptrs[i] = h->batch[static_cast<size_t>(i)].data();
+    lens[i] = static_cast<int64_t>(h->batch[static_cast<size_t>(i)].size());
+  }
+  return n;
 }
 
 const char *dmlc_tpu_lsplit_error(void *handle) {

@@ -14,12 +14,22 @@
 // are stitched in order.
 
 #include <atomic>
+#include <cerrno>
 #include <charconv>
+#include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <thread>
 #include <vector>
+
+#if !(defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L)
+#include <clocale>   // newlocale/locale_t for the strtof fallback
+#if defined(__APPLE__)
+#include <xlocale.h>  // strtof_l lives here on Darwin
+#endif
+#endif
 
 namespace {
 
@@ -68,12 +78,64 @@ inline const char* skip_ws_nl(const char* p, const char* end) {
   return p;
 }
 
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
 inline bool parse_float_slow(const char*& p, const char* end, float* out) {
   auto res = std::from_chars(p, end, *out);
   if (res.ec != std::errc()) return false;
   p = res.ptr;
   return true;
 }
+#else
+// libstdc++ < 11 ships integer-only from_chars: emulate the float overload
+// with strtof over a NUL-terminated copy of the token.  Semantics kept
+// from_chars-shaped: no leading whitespace or '+', no hex floats (the
+// copy stops at 'x'/'X', so "0x1p3" parses as 0 with p left on the 'x' —
+// exactly what from_chars does), overflow fails (subnormals pass — glibc
+// flags them ERANGE but they are representable).  The copy is unbounded
+// via a heap fallback, so an over-long token can never be silently
+// parsed as a truncated prefix.  strtof runs under a pinned "C" numeric
+// locale: an embedder's setlocale(LC_NUMERIC, ...) must not fork parsing
+// (a de_DE radix would stop "1.5" at the '.').
+inline bool parse_float_slow(const char*& p, const char* end, float* out) {
+  if (p == end || *p == '+' || is_ws(*p) || *p == '\n') return false;
+  char buf[256];
+  std::string big;                 // only touched for tokens >= 255 chars
+  size_t n = 0;
+  const char* q = p;
+  for (; q != end; ++q) {
+    char c = *q;
+    bool tokenish = (c >= '0' && c <= '9') || c == '.' || c == '-' ||
+                    c == '+' || (c >= 'a' && c <= 'z') ||
+                    (c >= 'A' && c <= 'Z');
+    if (!tokenish || c == 'x' || c == 'X') break;
+    if (n < sizeof(buf) - 1) {
+      buf[n++] = c;
+    } else {
+      if (big.empty()) big.assign(buf, n);
+      big.push_back(c);
+    }
+  }
+  buf[n] = '\0';
+  const char* tok = big.empty() ? buf : big.c_str();
+  char* stop = nullptr;
+  errno = 0;
+#if defined(__GLIBC__) || defined(__APPLE__) || defined(__FreeBSD__)
+  static const locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  float v = strtof_l(tok, &stop, c_loc);
+#else
+  float v = std::strtof(tok, &stop);
+#endif
+  if (stop == tok) return false;
+  // ERANGE: overflow (±HUGE_VALF) and total underflow (rounded to 0) are
+  // from_chars out_of_range; a nonzero subnormal is representable and passes
+  if (errno == ERANGE && (v == HUGE_VALF || v == -HUGE_VALF || v == 0.0f)) {
+    return false;
+  }
+  *out = v;
+  p += (stop - tok);
+  return true;
+}
+#endif
 
 // Powers of ten as one branchless table indexed by e10 + 22.  Positive
 // powers up to 1e22 are exactly representable, so (double)mant * 10^e is a
@@ -500,7 +562,8 @@ void* dmlc_tpu_parse_libfm(const char* data, int64_t len, int nthread) {
 // ABI version handshake: the ctypes bridge refuses (and rebuilds) a stale
 // library whose entry points don't match what it expects.  Bump on any
 // signature change.
-int dmlc_tpu_abi_version() { return 4; }
+// 5: lsplit_open2 grew the ring-depth arg; batched lsplit_next_chunks
+int dmlc_tpu_abi_version() { return 5; }
 
 void* dmlc_tpu_parse_csv(const char* data, int64_t len, int nthread,
                          float missing) {

@@ -295,6 +295,24 @@ class MockS3:
                         f"{contents}{cps}{nct}</ListBucketResult>").encode()
                 self._reply(200, body)
 
+            def do_DELETE(self):
+                if not self._check_auth():
+                    return
+                bucket, key, query = self._parse()
+                store.requests.append(("DELETE", self.path))
+                if "uploadId" in query:
+                    # AbortMultipartUpload: drop the pending parts
+                    with store.lock:
+                        up = store.uploads.pop(query["uploadId"], None)
+                    if up is None:
+                        return self._reply(
+                            404, b"<Error><Code>NoSuchUpload</Code></Error>")
+                    return self._reply(204)
+                with store.lock:
+                    store.objects.pop((bucket, key), None)
+                    store.etags.pop((bucket, key), None)
+                self._reply(204)
+
             def do_PUT(self):
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length)

@@ -135,7 +135,11 @@ def test_timed_out_child_flight_dump_reaches_bench_json(tmp_path):
     env = os.environ.copy()
     env.update({
         "JAX_PLATFORMS": "cpu",
-        "BENCH_ROWS": "2000",
+        # enough rows that no host finishes staging+training inside 8s
+        # (2000 sat right at the 8s edge once the cache/parse path got
+        # faster); the child is SIGTERMed at the budget either way, so a
+        # bigger workload does not lengthen the test
+        "BENCH_ROWS": "40000",
         # the probe (import jax + touch a CPU device) passes comfortably;
         # the bench child cannot finish inside 8s, so it hard-times-out
         "BENCH_PROBE_TIMEOUT_S": "120",
@@ -159,7 +163,7 @@ def test_timed_out_child_flight_dump_reaches_bench_json(tmp_path):
                for d in flights[0]["flight"])
     # the same dumps are persisted stage-side for the wedge-proof trail
     stage = json.loads(
-        (tmp_path / "attempt__child_cpu_rows2000.json").read_text())
+        (tmp_path / "attempt__child_cpu_rows40000.json").read_text())
     assert "flight" in stage
 
 

@@ -463,3 +463,93 @@ def test_mid_epoch_reset_repeats(tmp_path):
         split_again = _records_noclose(split)
         split.close()
         assert split_again == full
+
+
+# ------------------------------------------------ batched deep-ring pops ----
+def test_deep_ring_batched_chunks_match_stream(tmp_path):
+    """ring>2 switches the bridge to the batched next_chunks pop (ONE
+    Python<->C crossing drains everything the prefetch ring buffered, the
+    VERDICT item-6 remote-path fix); tiny buffers force many chunks so a
+    single batch really carries several — bytes and record order must be
+    identical to the classic double-buffered pop."""
+    lines = [b"deep-%04d-%s" % (i, bytes([97 + i % 26]) * 32)
+             for i in range(800)]
+    blob = b"\n".join(lines) + b"\n"
+    p = tmp_path / "d.txt"
+    p.write_bytes(blob)
+    for ring in (2, 3, 8):
+        native = native_bridge.NativeLineSplit([str(p)], [len(blob)], 0, 1,
+                                               buffer_size=512, ring=ring)
+        chunks = []
+        while True:
+            c = native.next_chunk()
+            if c is None:
+                break
+            chunks.append(c)
+        native.close()
+        assert b"".join(chunks) == blob, f"ring={ring}"
+        assert len(chunks) > ring  # small buffers: batching genuinely engaged
+
+
+def test_deep_ring_views_stay_valid_across_batch(tmp_path):
+    """Views handed out of one batched pop must all stay readable until the
+    NEXT crossing — the C side parks the whole batch on the handle, so the
+    consumer can hold chunk i while chunk i+1 is being parsed."""
+    import ctypes
+
+    blob = b"\n".join(b"v%03d" % i for i in range(400)) + b"\n"
+    p = tmp_path / "v.txt"
+    p.write_bytes(blob)
+    native = native_bridge.NativeLineSplit([str(p)], [len(blob)], 0, 1,
+                                           buffer_size=256, ring=6)
+    held, out = [], []
+    while True:
+        view = native.next_chunk_view()
+        if view is None:
+            break
+        held.append(view)
+        if len(native._pending) == 0:
+            # batch drained: everything handed out of it is still intact
+            out += [ctypes.string_at(a, n) for a, n in held]
+            held.clear()
+    out += [ctypes.string_at(a, n) for a, n in held]
+    native.close()
+    assert b"".join(out) == blob
+
+
+def test_deep_ring_mid_epoch_reset_drops_stale_batch(tmp_path):
+    """reset() while the Python side still holds undrained batched views
+    must discard them — the repeat-read protocol over a deep ring."""
+    lines = [b"r%04d-%s" % (i, b"z" * 24) for i in range(600)]
+    uri = _write_files(tmp_path, [b"\n".join(lines) + b"\n"])
+    fs = fsys.LocalFileSystem()
+    import ctypes
+
+    split = NativeLineSplitter(fs, uri, 0, 1)
+    split._native._ring = 6  # force the batched pop on a local split
+    split._native._batch_ptrs = (ctypes.c_char_p * 6)()
+    split._native._batch_lens = (ctypes.c_int64 * 6)()
+    prefix = []
+    for _ in range(5):
+        r = split.next_record()
+        assert r is not None
+        prefix.append(bytes(r))
+    split.before_first()                    # pending batch must be dropped
+    assert split._native._pending == []
+    full = _records_noclose(split)
+    assert full[:5] == prefix and full == lines
+    split.close()
+
+
+def test_deep_ring_remote_default_and_env_override(tmp_path, monkeypatch):
+    """Ring policy: double buffer locally, deep pre-posted ring on the
+    remote callback path, DMLC_NATIVE_RING overrides both."""
+    from dmlc_core_tpu.io.input_split import _native_ring
+
+    assert _native_ring(None) == 2
+    assert _native_ring(object()) == 8
+    monkeypatch.setenv("DMLC_NATIVE_RING", "5")
+    assert _native_ring(None) == 5
+    assert _native_ring(object()) == 5
+    monkeypatch.setenv("DMLC_NATIVE_RING", "1")
+    assert _native_ring(object()) == 2   # floor: below 2 buys nothing

@@ -21,6 +21,17 @@ from dmlc_core_tpu.data import parse_proc, text_np
 from dmlc_core_tpu.data.factory import create_parser
 
 
+@pytest.fixture()
+def force_proc(monkeypatch):
+    """Make the process backend actually engage: with the native core
+    built, TextParserBase auto-disables the proc pool (the C++ parsers
+    thread without the GIL, so stacking processes only costs transport) —
+    but these tests exist to exercise the proc transport itself."""
+    from dmlc_core_tpu import native_bridge
+
+    monkeypatch.setattr(native_bridge, "available", lambda: False)
+
+
 # -- reference (naive) tokenizer implementations ------------------------------
 
 def naive_tokenize(data):
@@ -131,7 +142,7 @@ def _blocks_concat(parser):
 
 
 @pytest.mark.parametrize("fmt", ["libsvm", "libfm", "csv"])
-def test_proc_thread_serial_blocks_identical(tmp_path, monkeypatch, fmt):
+def test_proc_thread_serial_blocks_identical(tmp_path, monkeypatch, fmt, force_proc):
     uri = _gen_corpus(tmp_path, fmt)
     monkeypatch.setenv("DMLC_PARSE_PROC", "0")
     serial = _blocks_concat(create_parser(uri, type=fmt, nthread=1,
@@ -158,7 +169,7 @@ def test_proc_backend_invalid_env_falls_back(tmp_path, monkeypatch):
     parser.close()
 
 
-def test_proc_backend_bad_error_consistency(tmp_path, monkeypatch):
+def test_proc_backend_bad_error_consistency(tmp_path, monkeypatch, force_proc):
     """Garbage input raises the same ValueError class through every
     backend — not a hang, not a BrokenProcessPool."""
     path = tmp_path / "bad.libsvm"
@@ -175,7 +186,7 @@ def test_proc_backend_bad_error_consistency(tmp_path, monkeypatch):
         parser.close()
 
 
-def test_proc_backend_label_only_rows(tmp_path, monkeypatch):
+def test_proc_backend_label_only_rows(tmp_path, monkeypatch, force_proc):
     """A sub-range of featureless rows (rows > 0, zero nonzeros) must flow
     through the shm transport like any other — the empty index column comes
     back as a len-0 array, not None (regression: crashed attach_block)."""
@@ -191,7 +202,7 @@ def test_proc_backend_label_only_rows(tmp_path, monkeypatch):
     np.testing.assert_array_equal(labels, np.arange(2000) % 2)
 
 
-def test_failed_chunk_leaks_no_shm_segments(tmp_path, monkeypatch):
+def test_failed_chunk_leaks_no_shm_segments(tmp_path, monkeypatch, force_proc):
     """When one range of a chunk fails, the sibling ranges' segments must
     be unlinked before the error propagates (the workers hand lifetime to
     the consumer, so a dropped meta would leak /dev/shm until reboot)."""
@@ -230,7 +241,7 @@ def test_resolve_nproc_parsing():
     assert parse_proc.resolve_nproc({"DMLC_PARSE_PROC": "auto"}) >= 1
 
 
-def test_shm_leases_release(tmp_path, monkeypatch):
+def test_shm_leases_release(tmp_path, monkeypatch, force_proc):
     """Dropping the last RowBlock view releases its shm lease (the gauge
     returns to zero), and /dev/shm does not accumulate segments."""
     import gc
@@ -263,7 +274,7 @@ _KILL_PLAN = ('{"rules": [{"site": "data.parse_worker", "kind": "exit", '
 
 
 @pytest.mark.chaos
-def test_killed_parse_worker_surfaces_clean_error(tmp_path, monkeypatch):
+def test_killed_parse_worker_surfaces_clean_error(tmp_path, monkeypatch, force_proc):
     """A worker kill-at-site (fault kind 'exit') mid-chunk must surface as
     a RuntimeError on the consumer — with the ThreadedParser decorator in
     the stack, exactly where parse errors normally arrive — and never hang.
@@ -283,7 +294,7 @@ def test_killed_parse_worker_surfaces_clean_error(tmp_path, monkeypatch):
 
 
 @pytest.mark.chaos
-def test_killed_worker_then_fresh_parser_recovers(tmp_path, monkeypatch):
+def test_killed_worker_then_fresh_parser_recovers(tmp_path, monkeypatch, force_proc):
     uri = _gen_corpus(tmp_path, "libsvm", rows=500)
     monkeypatch.setenv("DMLC_PARSE_PROC", "2")
     monkeypatch.setenv("DMLC_FAULT_PLAN", _KILL_PLAN)
@@ -301,7 +312,7 @@ def test_killed_worker_then_fresh_parser_recovers(tmp_path, monkeypatch):
 
 
 @pytest.mark.chaos
-def test_same_parser_self_heals_after_worker_death(tmp_path, monkeypatch):
+def test_same_parser_self_heals_after_worker_death(tmp_path, monkeypatch, force_proc):
     """The documented self-heal covers a *retried* parser too: after a
     worker death discards the shared pool, the same parser's next epoch
     must build a fresh pool instead of submitting to the dead executor."""

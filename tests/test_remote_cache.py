@@ -1,0 +1,597 @@
+"""Fleet-shared remote page cache (ISSUE 12 tentpole):
+
+- open-by-footer over the ranged-read FS layer: one tail ranged read
+  proves the remote object is a complete v2 cache before any page moves;
+- publish (``DMLC_CACHE_REMOTE``): one worker stream-parses + uploads,
+  the fleet fetches and mmaps locally at zero-copy speed;
+- every untrustable remote shape (absent, footer-less, v1 framing, dtype
+  drift, truncated/corrupt page, mid-fetch faults) falls back to
+  stream-parsing with the right metric — a bad page is never served;
+- concurrent materialization from two processes is safe (atomic rename).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.data import page_cache
+from dmlc_core_tpu.data.factory import create_parser, create_row_block_iter
+from dmlc_core_tpu.data.iterators import DiskRowIter, _remote_cache_config
+from dmlc_core_tpu.data.page_cache import CacheFormatError
+from dmlc_core_tpu.data.row_block import RowBlockContainer
+from dmlc_core_tpu.io.stream import create_stream
+from tests.mock_s3 import MockS3
+
+ROWS = 3000
+
+
+@pytest.fixture()
+def mock_s3(monkeypatch, tmp_path):
+    server = MockS3().start()
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test-key")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "test-secret")
+    monkeypatch.setenv("AWS_REGION", "us-east-1")
+    monkeypatch.setenv("S3_ENDPOINT", f"http://127.0.0.1:{server.port}")
+    monkeypatch.setenv("DMLC_CACHE_LOCAL_DIR", str(tmp_path / "materialized"))
+    monkeypatch.delenv("DMLC_CACHE_REMOTE", raising=False)
+    yield server
+    server.stop()
+
+
+def _corpus(tmp_path, rows=ROWS):
+    rng = np.random.RandomState(3)
+    lines = []
+    for i in range(rows):
+        feats = sorted(rng.choice(40, size=rng.randint(1, 6), replace=False))
+        lines.append(f"{i % 2} " + " ".join(f"{j}:{rng.rand():.4f}"
+                                            for j in feats))
+    path = tmp_path / "data.libsvm"
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _counter(name, **labels):
+    return telemetry.get_registry().counter(name, **labels)
+
+
+def _publish_seed_cache(mock_s3, tmp_path, uri, remote):
+    """One 'first worker': parse + publish the v2 cache to ``remote``."""
+    it = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+    rows = sum(b.size for b in it)
+    it.close()
+    assert rows == ROWS
+    return rows
+
+
+def _wipe_local(tmp_path):
+    shutil.rmtree(str(tmp_path / "materialized"), ignore_errors=True)
+
+
+# ---------------------------------------------------------------- happy path --
+
+def test_publish_then_fleet_fetch_zero_copy(mock_s3, tmp_path, monkeypatch):
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/c.cache"
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "1")
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        publishes = _counter("dmlc_cache_remote_publishes_total")
+        hits = _counter("dmlc_cache_remote_hits_total")
+        fetched = _counter("dmlc_cache_remote_bytes_fetched_total")
+        p0, h0, f0 = publishes.value, hits.value, fetched.value
+        _publish_seed_cache(mock_s3, tmp_path, uri, remote)
+        assert ("bucket", "caches/c.cache") in mock_s3.objects
+        assert publishes.value == p0 + 1
+
+        # "another host": no local materialization yet -> remote hit
+        _wipe_local(tmp_path)
+        it = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+        epoch1 = list(it)
+        it.before_first()
+        epoch2 = list(it)
+        assert sum(b.size for b in epoch1) == ROWS
+        for a, b in zip(epoch1, epoch2):
+            assert a.offset is b.offset      # mmap-backed, zero-copy epochs
+            assert a.index is b.index
+            assert not a.index.flags.writeable
+        it.close()
+        assert hits.value == h0 + 1
+        remote_size = len(mock_s3.objects[("bucket", "caches/c.cache")])
+        assert fetched.value - f0 == remote_size
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_second_run_on_same_host_skips_remote(mock_s3, tmp_path, monkeypatch):
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/c.cache"
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "1")
+    _publish_seed_cache(mock_s3, tmp_path, uri, remote)
+    _wipe_local(tmp_path)
+    it = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+    assert sum(b.size for b in it) == ROWS
+    it.close()
+    mock_s3.requests.clear()
+    it2 = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+    assert sum(b.size for b in it2) == ROWS
+    it2.close()
+    # warm local materialization: the object store sees zero traffic
+    assert mock_s3.requests == []
+
+
+def test_publish_opt_in_only(mock_s3, tmp_path):
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/unpublished.cache"
+    it = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+    assert sum(b.size for b in it) == ROWS
+    it.close()
+    # fetch was attempted (miss), but nothing was uploaded
+    assert ("bucket", "caches/unpublished.cache") not in mock_s3.objects
+
+
+def test_explicit_remote_uri_with_local_cachefile(mock_s3, tmp_path,
+                                                  monkeypatch):
+    """DMLC_CACHE_REMOTE=<uri> names the fleet location even when the
+    #cachefile is a plain local path."""
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/explicit.cache"
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", remote)
+    local = str(tmp_path / "local.cache")
+    it = create_row_block_iter(f"{uri}#{local}", type="libsvm")
+    assert sum(b.size for b in it) == ROWS
+    it.close()
+    assert ("bucket", "caches/explicit.cache") in mock_s3.objects
+    assert os.path.exists(local)
+    # a second worker with its own local path fetches the published cache
+    local2 = str(tmp_path / "local2.cache")
+    mock_s3.requests.clear()
+    it2 = create_row_block_iter(f"{uri}#{local2}", type="libsvm")
+    assert sum(b.size for b in it2) == ROWS
+    it2.close()
+    assert any(m == "GET" for m, _ in mock_s3.requests)
+    with open(local2, "rb") as f:
+        assert f.read(8) == page_cache.HEAD_MAGIC
+
+
+def test_remote_cache_config_parsing(monkeypatch):
+    monkeypatch.delenv("DMLC_CACHE_REMOTE", raising=False)
+    assert _remote_cache_config("/tmp/c.cache") == (None, False)
+    assert _remote_cache_config("s3://b/c.cache") == ("s3://b/c.cache", False)
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "1")
+    assert _remote_cache_config("s3://b/c.cache") == ("s3://b/c.cache", True)
+    assert _remote_cache_config("/tmp/c.cache") == (None, False)
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "s3://b/x.cache")
+    assert _remote_cache_config("/tmp/c.cache") == ("s3://b/x.cache", True)
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "0")
+    assert _remote_cache_config("s3://b/c.cache") == ("s3://b/c.cache", False)
+    # the repo-wide bool grammar: case-insensitive, garbage raises (a
+    # hand-rolled lowercase falsy list silently ENABLED publish on "False")
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "False")
+    assert _remote_cache_config("s3://b/c.cache") == ("s3://b/c.cache", False)
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "YES")
+    assert _remote_cache_config("s3://b/c.cache") == ("s3://b/c.cache", True)
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "maybe")
+    with pytest.raises(ValueError):
+        _remote_cache_config("s3://b/c.cache")
+
+
+# ------------------------------------------------------- untrustable remotes --
+
+def _expect_fallback(mock_s3, tmp_path, uri, remote, reason):
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        misses = _counter("dmlc_cache_remote_misses_total", reason=reason)
+        m0 = misses.value
+        it = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+        rows = sum(b.size for b in it)
+        it.close()
+        assert rows == ROWS
+        assert misses.value == m0 + 1
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_absent_remote_falls_back(mock_s3, tmp_path):
+    uri = _corpus(tmp_path)
+    _expect_fallback(mock_s3, tmp_path, uri, "s3://bucket/none.cache",
+                     "absent")
+
+
+def test_footerless_remote_falls_back(mock_s3, tmp_path):
+    """A remote object that is a prefix of a real cache (interrupted
+    upload) has no validated footer and must never be trusted."""
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/footerless.cache"
+    it = create_row_block_iter(f"{uri}#{str(tmp_path / 'seed.cache')}",
+                               type="libsvm")
+    it.close()
+    blob = open(str(tmp_path / "seed.cache"), "rb").read()
+    mock_s3.objects[("bucket", "caches/footerless.cache")] = blob[:-40]
+    _expect_fallback(mock_s3, tmp_path, uri, remote, "invalid")
+
+
+def test_v1_cache_at_remote_uri_falls_back(mock_s3, tmp_path):
+    """Pre-PR 4 remote caches used v1 RowBlockContainer stream framing;
+    they are not fetchable and must fall back, not crash."""
+    uri = _corpus(tmp_path)
+    container = RowBlockContainer(np.uint32)
+    for block in create_parser(uri, type="libsvm", threaded=False):
+        container.push_block(block)
+    fo = create_stream("s3://bucket/caches/v1.cache", "w")
+    container.save(fo)
+    fo.close()
+    _expect_fallback(mock_s3, tmp_path, uri, "s3://bucket/caches/v1.cache",
+                     "invalid")
+
+
+def test_dtype_mismatch_remote_falls_back(mock_s3, tmp_path, monkeypatch):
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/u64.cache"
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "1")
+    it = DiskRowIter(create_parser(uri, type="libsvm"), remote,
+                     index_dtype=np.uint64)
+    it.close()
+    monkeypatch.delenv("DMLC_CACHE_REMOTE")
+    _wipe_local(tmp_path)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        misses = _counter("dmlc_cache_remote_misses_total", reason="invalid")
+        m0 = misses.value
+        it2 = DiskRowIter(create_parser(uri, type="libsvm"), remote,
+                          index_dtype=np.uint32)
+        assert sum(b.size for b in it2) == ROWS
+        it2.close()
+        assert misses.value == m0 + 1
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_tiny_remote_object_falls_back(mock_s3, tmp_path):
+    uri = _corpus(tmp_path)
+    mock_s3.objects[("bucket", "tiny.cache")] = b"xx"
+    _expect_fallback(mock_s3, tmp_path, uri, "s3://bucket/tiny.cache",
+                     "invalid")
+
+
+# ------------------------------------------------- concurrent materialization --
+
+def test_concurrent_materialization_atomic_rename(mock_s3, tmp_path,
+                                                  monkeypatch):
+    """Two processes fetch the same remote cache into the same local path
+    concurrently: both must serve every row; the rename race is safe
+    because each renames a fully validated temp file."""
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/race.cache"
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "1")
+    _publish_seed_cache(mock_s3, tmp_path, uri, remote)
+    _wipe_local(tmp_path)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = (
+        "import sys\n"
+        "from dmlc_core_tpu.data.factory import create_row_block_iter\n"
+        f"it = create_row_block_iter({uri + '#' + remote!r}, type='libsvm')\n"
+        f"assert sum(b.size for b in it) == {ROWS}\n"
+        "it.close()\n"
+        "print('OK')\n")
+    env = os.environ.copy()
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen([sys.executable, "-c", script], env=env,
+                              cwd=repo, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for _ in range(2)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert all("OK" in o for o in outs), outs
+    local = page_cache.default_local_path(remote)
+    reader = page_cache.PageCacheReader(local, np.uint32)
+    assert sum(b.size for b in reader.blocks) == ROWS
+    reader.close()
+    # no orphaned fetch temps
+    d = os.path.dirname(local)
+    assert [n for n in os.listdir(d) if ".tmp" in n] == []
+
+
+def test_concurrent_fetch_threads_same_process(mock_s3, tmp_path,
+                                               monkeypatch):
+    """Two loaders in ONE process (train + eval over the same dataset)
+    fetching concurrently: per-call temp names keep one thread from
+    truncating the other's in-progress bytes — and from writing into the
+    committed inode after the rename (a pid-only temp name did both)."""
+    import threading
+
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/threads.cache"
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "1")
+    _publish_seed_cache(mock_s3, tmp_path, uri, remote)
+    _wipe_local(tmp_path)
+    local = page_cache.default_local_path(remote)
+    results, errors = [], []
+
+    def one_fetch():
+        try:
+            results.append(page_cache.fetch_remote_cache(
+                remote, local, np.uint32))
+        except BaseException as exc:  # noqa: BLE001 — ferried to the assert
+            errors.append(exc)
+
+    threads = [threading.Thread(target=one_fetch) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert errors == []
+    assert len(results) == 2
+    reader = page_cache.PageCacheReader(local, np.uint32)
+    assert sum(b.size for b in reader.blocks) == ROWS
+    reader.close()
+    assert [n for n in os.listdir(os.path.dirname(local))
+            if ".tmp" in n] == []
+
+
+# ------------------------------------------------------------------ publish ---
+
+def _build_seed_cache(tmp_path, uri):
+    """Stream-parse the corpus into a local v2 cache file; returns its path."""
+    seed = str(tmp_path / "seed.cache")
+    it = create_row_block_iter(f"{uri}#{seed}", type="libsvm")
+    it.close()
+    return seed
+
+
+def test_failed_publish_never_lands_truncated_object(mock_s3, tmp_path,
+                                                     monkeypatch):
+    """A publish that dies mid-upload must ABANDON, not commit: close()
+    completes the multipart upload, so the old finally-close landed a
+    footer-less truncated object at the fleet URI that every worker's
+    fetch would classify invalid and re-parse around."""
+    from dmlc_core_tpu.io import s3_filesys
+
+    uri = _corpus(tmp_path)
+    seed = _build_seed_cache(tmp_path, uri)
+
+    calls = []
+
+    def boom(self, data):
+        calls.append(len(data))
+        raise OSError("disk pulled mid-read")
+
+    monkeypatch.setattr(s3_filesys.S3WriteStream, "write", boom)
+    with pytest.raises(OSError, match="mid-read"):
+        page_cache.publish_cache(seed, "s3://bucket/caches/partial.cache")
+    assert calls, "publish never reached the stream"
+    assert ("bucket", "caches/partial.cache") not in mock_s3.objects
+    assert mock_s3.uploads == {}
+
+
+def test_failed_publish_to_write_through_target_removes_partial(
+        tmp_path, monkeypatch):
+    """Streams with no abort() (plain files, hdfs://) have already
+    materialized partial bytes AT the target when the publish dies:
+    abandoning must delete them — a leftover footer-less object would be
+    classified invalid by every fetcher until someone overwrites it."""
+    from dmlc_core_tpu.io import filesys
+
+    monkeypatch.delenv("DMLC_CACHE_REMOTE", raising=False)
+    uri = _corpus(tmp_path)
+    seed = _build_seed_cache(tmp_path, uri)
+    target = str(tmp_path / "published.rbc")
+
+    real_write = filesys._LocalFileStream.write
+
+    def boom(self, data):
+        real_write(self, data[: len(data) // 2])
+        raise OSError("link dropped mid-publish")
+
+    monkeypatch.setattr(filesys._LocalFileStream, "write", boom)
+    with pytest.raises(OSError, match="mid-publish"):
+        page_cache.publish_cache(seed, target)
+    assert not os.path.exists(target)
+
+
+def test_s3_write_stream_abort_leaves_nothing(mock_s3, monkeypatch):
+    """abort() after multipart parts are already uploaded: the upload is
+    aborted server-side, nothing lands at the key, and a later close()
+    is a no-op rather than a second commit attempt."""
+    monkeypatch.setenv("DMLC_S3_WRITE_BUFFER_MB", "5")  # 5 MB parts (floor)
+    fo = create_stream("s3://bucket/aborted.bin", "w")
+    fo.write(b"\0" * (6 << 20))          # > one part: multipart initiated
+    assert mock_s3.uploads, "multipart upload never started"
+    fo.abort()
+    fo.close()                            # no-op after abort
+    assert mock_s3.uploads == {}
+    assert ("bucket", "aborted.bin") not in mock_s3.objects
+
+
+# ------------------------------------------------------------------- chaos ----
+
+@pytest.mark.chaos
+def test_midfetch_truncation_falls_back(mock_s3, tmp_path):
+    """An injected truncation mid page fetch (cut object / dropped
+    connection) must warn, count a rebuild, and stream-parse — rows stay
+    correct and complete."""
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/trunc.cache"
+    it = create_row_block_iter(f"{uri}#{str(tmp_path / 'seed.cache')}",
+                               type="libsvm")
+    it.close()
+    mock_s3.objects[("bucket", "caches/trunc.cache")] = open(
+        str(tmp_path / "seed.cache"), "rb").read()
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    fault.configure({"rules": [
+        # after the header+tail probes: cut the first page fetch short
+        {"site": "io.cache.fetch", "kind": "truncate", "keep": 64,
+         "after": 2, "times": 1}]})
+    try:
+        rebuilds = _counter("dmlc_cache_rebuilds_total")
+        misses = _counter("dmlc_cache_remote_misses_total", reason="invalid")
+        r0, m0 = rebuilds.value, misses.value
+        it2 = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+        assert sum(b.size for b in it2) == ROWS
+        it2.close()
+        assert [s for s, _, _ in fault.fires()] == ["io.cache.fetch"]
+        assert rebuilds.value == r0 + 1
+        assert misses.value == m0 + 1
+    finally:
+        fault.clear()
+        if not was_enabled:
+            telemetry.disable()
+
+
+@pytest.mark.chaos
+def test_midfetch_reset_falls_back(mock_s3, tmp_path):
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/reset.cache"
+    it = create_row_block_iter(f"{uri}#{str(tmp_path / 'seed.cache')}",
+                               type="libsvm")
+    it.close()
+    mock_s3.objects[("bucket", "caches/reset.cache")] = open(
+        str(tmp_path / "seed.cache"), "rb").read()
+    fault.configure({"rules": [
+        {"site": "io.cache.fetch", "kind": "reset", "times": 1}]})
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        misses = _counter("dmlc_cache_remote_misses_total", reason="io")
+        m0 = misses.value
+        it2 = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+        assert sum(b.size for b in it2) == ROWS
+        it2.close()
+        assert misses.value == m0 + 1
+    finally:
+        fault.clear()
+        if not was_enabled:
+            telemetry.disable()
+
+
+@pytest.mark.chaos
+def test_corrupt_remote_page_never_served(mock_s3, tmp_path):
+    """Bit-rot inside one remote page: the per-page CRC rejects it, the
+    local materialization never appears, and the rows come from a clean
+    stream parse."""
+    uri = _corpus(tmp_path)
+    remote = "s3://bucket/caches/corrupt.cache"
+    it = create_row_block_iter(f"{uri}#{str(tmp_path / 'seed.cache')}",
+                               type="libsvm")
+    it.close()
+    blob = bytearray(open(str(tmp_path / "seed.cache"), "rb").read())
+    blob[200:204] = b"\xff\xff\xff\xff"       # inside page 0's payload
+    mock_s3.objects[("bucket", "caches/corrupt.cache")] = bytes(blob)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        rebuilds = _counter("dmlc_cache_rebuilds_total")
+        r0 = rebuilds.value
+        it2 = create_row_block_iter(f"{uri}#{remote}", type="libsvm")
+        assert sum(b.size for b in it2) == ROWS
+        it2.close()
+        assert rebuilds.value == r0 + 1
+        # the corrupt fetch must not have materialized anything local
+        local = page_cache.default_local_path(remote)
+        # (the fallback BUILD materializes; what matters is it validates)
+        reader = page_cache.PageCacheReader(local, np.uint32)
+        assert sum(b.size for b in reader.blocks) == ROWS
+        reader.close()
+    finally:
+        fault.clear()
+        if not was_enabled:
+            telemetry.disable()
+
+
+# ------------------------------------------------------------ unit-level bits --
+
+def test_open_remote_layout_spans(mock_s3, tmp_path):
+    uri = _corpus(tmp_path)
+    seed = str(tmp_path / "seed.cache")
+    it = create_row_block_iter(f"{uri}#{seed}", type="libsvm")
+    it.close()
+    blob = open(seed, "rb").read()
+    mock_s3.objects[("bucket", "layout.cache")] = blob
+    layout = page_cache._open_remote_layout("s3://bucket/layout.cache",
+                                            np.dtype(np.uint32))
+    assert layout.size == len(blob)
+    assert len(layout.header) == 32
+    # spans tile [header, toc) exactly
+    pos = 32
+    for off, nbytes in layout.spans:
+        assert off == pos
+        pos += nbytes
+    assert blob[:32] == layout.header
+    assert blob[pos:] == layout.tail
+
+
+def test_fetch_remote_cache_prefetch_depths(mock_s3, tmp_path, monkeypatch):
+    """Every ring depth produces a byte-identical local file."""
+    uri = _corpus(tmp_path)
+    seed = str(tmp_path / "seed.cache")
+    it = create_row_block_iter(f"{uri}#{seed}", type="libsvm")
+    it.close()
+    blob = open(seed, "rb").read()
+    mock_s3.objects[("bucket", "depth.cache")] = blob
+    for depth in (1, 2, 8):
+        dst = str(tmp_path / f"fetched-{depth}.cache")
+        nbytes = page_cache.fetch_remote_cache(
+            "s3://bucket/depth.cache", dst, np.uint32, prefetch=depth)
+        assert nbytes == len(blob)
+        assert open(dst, "rb").read() == blob
+
+
+def test_multi_page_fetch_ring_and_page_bytes_knob(mock_s3, tmp_path,
+                                                   monkeypatch):
+    """A multi-page fetch through the pre-posted ring reassembles the
+    exact bytes in page order at every depth, and DMLC_CACHE_PAGE_BYTES
+    plumbs into the build's page granularity (floored at 1 MB)."""
+    # build a 3-page cache directly (the unit of the fetch pipeline)
+    seed = str(tmp_path / "paged.cache")
+    writer = page_cache.PageCacheWriter(seed, np.uint32)
+    rng = np.random.RandomState(7)
+    rows = 0
+    for _ in range(3):
+        container = RowBlockContainer(np.uint32)
+        for i in range(500):
+            feats = sorted(rng.choice(40, size=3, replace=False))
+            container.push_row(float(i % 2), feats, rng.rand(3))
+            rows += 1
+        writer.write_page(container)
+    writer.commit()
+    blob = open(seed, "rb").read()
+    mock_s3.objects[("bucket", "paged.cache")] = blob
+    layout = page_cache._open_remote_layout("s3://bucket/paged.cache",
+                                            np.dtype(np.uint32))
+    assert len(layout.spans) == 3
+    for depth in (1, 3):
+        dst = str(tmp_path / f"paged-{depth}.cache")
+        nbytes = page_cache.fetch_remote_cache(
+            "s3://bucket/paged.cache", dst, np.uint32, prefetch=depth)
+        assert nbytes == len(blob)
+        assert open(dst, "rb").read() == blob
+    # the materialized multi-page cache serves without re-parsing
+    it = DiskRowIter(lambda: (_ for _ in ()).throw(AssertionError(
+        "warm multi-page open must not re-parse")),
+        str(tmp_path / "paged-3.cache"))
+    assert sum(b.size for b in it) == rows
+    it.close()
+    # knob plumbing: env page size reaches the builder (1 MB floor)
+    monkeypatch.setenv("DMLC_CACHE_PAGE_BYTES", str(3 << 20))
+    uri = _corpus(tmp_path, rows=50)
+    it2 = create_row_block_iter(f"{uri}#{tmp_path / 'k.cache'}",
+                                type="libsvm")
+    assert it2._page_bytes == 3 << 20
+    it2.close()
+    monkeypatch.setenv("DMLC_CACHE_PAGE_BYTES", "1024")   # below the floor
+    it3 = DiskRowIter(lambda: (_ for _ in ()).throw(AssertionError("x")),
+                      str(tmp_path / "paged-1.cache"))
+    assert it3._page_bytes == 1 << 20
+    it3.close()
