@@ -112,6 +112,78 @@ def collect_flight(since, max_entries=30):
     return out
 
 
+def find_last_live_capture(roots=None):
+    """The newest persisted ON-CHIP stage capture, for embedding in a
+    CPU-fallback round (VERDICT "Next round" item 1b): real TPU evidence
+    exists committed under benchmarks/results/ (and, mid-run, in
+    BENCH_STAGE_DIR) while the driver's own probe window keeps falling
+    back — the fallback JSON should carry that evidence, clearly labeled,
+    instead of letting it sit invisible in the tree.
+
+    Scans the given roots (default: BENCH_STAGE_DIR + the committed
+    benchmarks/results/ next to this script) for stage JSONs with
+    ``platform == "tpu"`` AND a measured value — probe records say "tpu"
+    without measuring anything and must not be promoted to evidence.
+    Returns the embeddable block (source path, ISO timestamp, the
+    capture's headline fields) or None.
+    """
+    if roots is None:
+        roots = []
+        if STAGE_DIR:
+            roots.append(STAGE_DIR)
+        roots.append(os.path.join(os.path.dirname(SCRIPT_PATH),
+                                  "benchmarks", "results"))
+    best = None
+    best_ts = -1.0
+    for root in roots:
+        pattern = os.path.join(root, "**", "*.json")
+        try:
+            paths = glob.glob(pattern, recursive=True)
+        except OSError:
+            continue
+        for path in paths:
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            if not isinstance(data, dict) or data.get("platform") != "tpu":
+                continue
+            if not isinstance(data.get("value"), (int, float)) \
+                    or data["value"] <= 0:
+                continue  # a probe record or an errored stage, not evidence
+            ts = data.get("time") or 0.0
+            try:
+                ts = float(ts) or os.path.getmtime(path)
+            except (TypeError, ValueError, OSError):
+                ts = 0.0
+            if ts > best_ts:
+                best, best_ts = (path, data), ts
+    if best is None:
+        return None
+    path, data = best
+    detail = data.get("detail", {})
+    if isinstance(detail, dict):
+        # the registry snapshot is bulky and meaningless out of context;
+        # the headline + device/feed fields are the evidence
+        detail = {k: v for k, v in detail.items() if k != "telemetry"}
+    return {
+        "note": ("committed capture from an EARLIER run's probe window — "
+                 "NOT this run's measurement (top-level platform/"
+                 "tpu_available describe THIS run)"),
+        "source": os.path.relpath(path, os.path.dirname(SCRIPT_PATH)),
+        "captured_at_unix": round(best_ts, 3),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime(best_ts)),
+        "platform": "tpu",
+        "metric": data.get("metric"),
+        "value": data.get("value"),
+        "unit": data.get("unit"),
+        "vs_baseline": data.get("vs_baseline"),
+        "detail": detail,
+    }
+
+
 def persist_stage(name, payload):
     """Write one stage's result to its own file immediately (wedge-proofing:
     partial evidence survives if a later stage hangs the run)."""
@@ -646,6 +718,19 @@ def main():
         # metric: a CPU-fallback round now carries the evidence of where
         # the accelerator attempt's 300s actually went
         result.setdefault("detail", {})["timeout_flights"] = _TIMEOUT_FLIGHTS
+    if result.get("platform") != "tpu":
+        # CPU fallback: embed the newest committed on-chip capture as a
+        # clearly-labeled, timestamped block (VERDICT item 1b).  Top-level
+        # platform/tpu_available stay honest about THIS run — the capture
+        # rides in detail, never substitutes for the measurement.
+        try:
+            capture = find_last_live_capture()
+        except Exception as e:  # the fallback JSON must still emit
+            print(f"bench: last-live-capture scan failed ({e!r})",
+                  file=sys.stderr)
+            capture = None
+        if capture is not None:
+            result.setdefault("detail", {})["last_live_capture"] = capture
     if _TELEMETRY_DIR:
         # always surfaced (not only on timeout): the dir holds the run's
         # trace files — `python -m dmlc_core_tpu.telemetry trace <dir>`

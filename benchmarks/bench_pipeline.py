@@ -6,6 +6,7 @@ split_read_test.cc, libsvm_parser_test.cc — they print MB/sec).
     python benchmarks/bench_pipeline.py parser <uri> [format] [nthread]
     python benchmarks/bench_pipeline.py parser-ab <uri> [format] [out.json] [workers]
     python benchmarks/bench_pipeline.py cache-ab [rows] [out.json] [trace_dir]
+    python benchmarks/bench_pipeline.py columnar-ab [rows] [out.json] [trace_dir]
     python benchmarks/bench_pipeline.py gen    <path> [rows] [features] [libsvm|libfm|csv]
     python benchmarks/bench_pipeline.py genrec <path.rec> [records] [bytes]
     python benchmarks/bench_pipeline.py infeed <path.rec> [record_bytes] [batch]
@@ -16,6 +17,17 @@ single-worker, thread-pool, and process-pool (DMLC_PARSE_PROC) backends,
 prints rows/s per stage (raw split read vs parse), and writes the JSON
 record next to the telemetry artifact in CI (and into
 benchmarks/results/ when run by hand).
+
+``columnar-ab`` is the zero-copy columnar-ingest A/B behind the
+"Columnar ingest" table in docs/performance.md: the same logical dataset
+is drained through the cold text parser and through the Arrow/Parquet
+front door (``data/arrow_ingest.py``), then through the Parquet ->
+v2-page-cache build and a warm mmap epoch.  The Arrow stage runs under
+``DMLC_ARROW_REQUIRE_ZERO_COPY=1`` and the engagement gate exits nonzero
+if any column took the bulk-copy path (the
+``dmlc_ingest_columns_total{mode}`` counters are the ground truth, plus a
+direct buffer-identity assertion against the Arrow child buffers) — a
+silent copy can never be logged as a zero-copy number.
 
 ``cache-ab`` is the fleet-shared remote page cache A/B on a loopback
 mock-S3 store: worker A cold-parses the remote corpus, builds the v2
@@ -291,6 +303,216 @@ def bench_cache_ab(rows=400_000, out_json=None, trace_dir=None):
     return results
 
 
+def _gen_columnar_corpus(work, rows, features=28, seed=0):
+    """The same logical dataset three times: libsvm text, sparse-schema
+    Parquet, and sparse-schema Arrow IPC (label float32 + large_list
+    index/value), written from one array draw so the A/B — and the
+    byte-identity check — compare like against like.  Values are written
+    with full float64-repr precision so the text parse round-trips to the
+    identical float32 bits."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.RandomState(seed)
+    text_path = os.path.join(work, "data.libsvm")
+    parquet_path = os.path.join(work, "data.parquet")
+    ipc_path = os.path.join(work, "data.arrow")
+    pq_writer = ipc_writer = None
+    with open(text_path, "w") as f:
+        for start in range(0, rows, 65536):
+            n = min(65536, rows - start)
+            x = rng.randn(n, features).astype(np.float32)
+            y = rng.randint(0, 2, n).astype(np.float32)
+            lines = []
+            for i in range(n):
+                feats = " ".join(f"{j}:{float(x[i, j])!r}"
+                                 for j in range(features))
+                lines.append(f"{int(y[i])} {feats}")
+            f.write("\n".join(lines) + "\n")
+            offsets = np.arange(n + 1, dtype=np.int64) * features
+            index = np.tile(np.arange(features, dtype=np.uint32), n)
+            table = pa.table({
+                "label": pa.array(y, type=pa.float32()),
+                "index": pa.LargeListArray.from_arrays(
+                    offsets, pa.array(index, type=pa.uint32())),
+                "value": pa.LargeListArray.from_arrays(
+                    offsets, pa.array(x.reshape(-1), type=pa.float32())),
+            })
+            if pq_writer is None:
+                # uncompressed PLAIN pages: the A/B measures the ingest
+                # boundary, not a codec
+                pq_writer = pq.ParquetWriter(parquet_path, table.schema,
+                                             compression="none",
+                                             use_dictionary=False)
+                ipc_writer = pa.ipc.new_file(ipc_path, table.schema)
+            pq_writer.write_table(table)
+            for batch in table.to_batches():
+                ipc_writer.write_batch(batch)
+    pq_writer.close()
+    ipc_writer.close()
+    print(f"wrote {rows} rows: {os.path.getsize(text_path) / (1 << 20):.1f} "
+          f"MB libsvm text, {os.path.getsize(parquet_path) / (1 << 20):.1f} "
+          f"MB parquet, {os.path.getsize(ipc_path) / (1 << 20):.1f} MB "
+          "arrow ipc")
+    return text_path, parquet_path, ipc_path
+
+
+def bench_columnar_ab(rows=400_000, out_json=None, trace_dir=None):
+    """Cold text parse vs zero-copy Arrow/Parquet ingest vs warm page cache.
+
+    Exits nonzero when the zero-copy path did not engage — a bulk-copy
+    fallback's throughput recorded as a "zero-copy ingest" number would
+    poison the longitudinal series, exactly like cache-ab's
+    fallback-to-parse gate."""
+    import json
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from dmlc_core_tpu import telemetry
+    from dmlc_core_tpu.data.arrow_ingest import require_pyarrow
+
+    require_pyarrow()   # loud gate: this A/B is ABOUT the pyarrow path
+    rows = int(rows)
+    work = tempfile.mkdtemp(prefix="columnar-ab-")
+    trace_dir = trace_dir or os.path.join(work, "trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    telemetry.enable(trace_dir)
+
+    text_path, parquet_path, ipc_path = _gen_columnar_corpus(work, rows)
+    from dmlc_core_tpu.data.factory import create_parser, create_row_block_iter
+
+    def drain(uri, stage, **kwargs):
+        with telemetry.span(f"columnar_ab.{stage}", rows=rows):
+            t0 = _time.perf_counter()
+            parser = create_parser(uri, **kwargs)
+            got = nnz = 0
+            label_sum = np.float64(0.0)
+            for block in parser:
+                got += block.size
+                nnz += block.num_nonzero
+                label_sum += np.float64(block.label.sum(dtype=np.float64))
+            elapsed = _time.perf_counter() - t0
+            if hasattr(parser, "close"):
+                parser.close()
+        assert got == rows, f"{stage}: {got} of {rows} rows"
+        return elapsed, nnz, float(label_sum)
+
+    cold_s, text_nnz, text_labels = drain(text_path, "cold_text_parse",
+                                          type="libsvm")
+
+    # the columnar stages run strict: ANY bulk-copy column materialization
+    # raises instead of silently degrading the number being measured
+    os.environ["DMLC_ARROW_REQUIRE_ZERO_COPY"] = "1"
+    try:
+        parquet_s, pq_nnz, pq_labels = drain(parquet_path, "parquet_ingest")
+        ipc_s, ipc_nnz, ipc_labels = drain(ipc_path, "arrow_ipc_ingest")
+    finally:
+        os.environ.pop("DMLC_ARROW_REQUIRE_ZERO_COPY", None)
+    for name, got in (("parquet", (pq_nnz, pq_labels)),
+                      ("arrow ipc", (ipc_nnz, ipc_labels))):
+        assert got == (text_nnz, text_labels), (
+            f"{name} corpus disagrees with the text corpus: "
+            f"{got} vs {(text_nnz, text_labels)}")
+
+    # direct buffer-identity witness, independent of the counters: the
+    # CSR value column of IPC batch 0 aliases the file MAPPING itself
+    import pyarrow as pa
+
+    from dmlc_core_tpu.data.arrow_ingest import table_to_block
+
+    mm = pa.memory_map(ipc_path)
+    table = pa.Table.from_batches(
+        [pa.ipc.open_file(mm).get_batch(0)])
+    block, stats = table_to_block(table)
+    child = table.column("value").chunk(0).values
+    arrow_view = np.frombuffer(child.buffers()[1], dtype=np.float32,
+                               count=len(child) + child.offset)
+    buffer_identical = bool(np.shares_memory(block.value, arrow_view))
+    del block, table, child, arrow_view
+
+    # engagement gate ground truth: the ingest counters for the WHOLE drain
+    metrics = telemetry.snapshot()["metrics"]
+
+    def mode_count(mode):
+        fam = metrics.get("dmlc_ingest_columns_total", {"samples": []})
+        return sum(s["value"] for s in fam["samples"]
+                   if s.get("labels", {}).get("mode") == mode)
+
+    zero_copy_cols = mode_count("zero_copy")
+    bulk_copy_cols = mode_count("bulk_copy")
+    zero_copy_engaged = (zero_copy_cols > 0 and bulk_copy_cols == 0
+                         and buffer_identical)
+
+    # parquet -> v2 page cache (build epoch), then the warm mmap epoch
+    cache = os.path.join(work, "data.cache")
+    with telemetry.span("columnar_ab.cache_build_from_parquet", rows=rows):
+        t0 = _time.perf_counter()
+        it = create_row_block_iter(f"{parquet_path}#{cache}")
+        got = sum(b.size for b in it)
+        build_s = _time.perf_counter() - t0
+    assert got == rows, f"cache build: {got} of {rows} rows"
+    it.before_first()
+    t0 = _time.perf_counter()
+    got2 = sum(b.size for b in it)
+    warm_s = _time.perf_counter() - t0
+    it.close()
+    assert got2 == rows, f"warm epoch: {got2} of {rows} rows"
+
+    results = {
+        "rows": rows,
+        "text_bytes": os.path.getsize(text_path),
+        "parquet_bytes": os.path.getsize(parquet_path),
+        "arrow_ipc_bytes": os.path.getsize(ipc_path),
+        "zero_copy_engaged": zero_copy_engaged,
+        "zero_copy_columns": int(zero_copy_cols),
+        "bulk_copy_columns": int(bulk_copy_cols),
+        "buffer_identity": buffer_identical,
+        "stages": {
+            "cold_text_parse": {
+                "seconds": cold_s, "rows_per_s": rows / max(cold_s, 1e-9)},
+            "parquet_ingest": {
+                "seconds": parquet_s,
+                "rows_per_s": rows / max(parquet_s, 1e-9)},
+            "arrow_ipc_ingest": {
+                "seconds": ipc_s, "rows_per_s": rows / max(ipc_s, 1e-9)},
+            "cache_build_from_parquet": {
+                "seconds": build_s, "rows_per_s": rows / max(build_s, 1e-9)},
+            "warm_mmap_epoch2": {
+                "seconds": warm_s, "rows_per_s": rows / max(warm_s, 1e-9)},
+        },
+        "parquet_vs_text_speedup": cold_s / max(parquet_s, 1e-9),
+        "arrow_vs_text_speedup": cold_s / max(ipc_s, 1e-9),
+    }
+    print(f"{'stage':>26}  {'rows/s':>12}  {'seconds':>8}")
+    for name, st in results["stages"].items():
+        print(f"{name:>26}  {st['rows_per_s']:>12.0f}  {st['seconds']:>8.2f}")
+    print(f"parquet ingest vs cold text parse: "
+          f"{results['parquet_vs_text_speedup']:.2f}x; arrow ipc: "
+          f"{results['arrow_vs_text_speedup']:.2f}x  "
+          f"(zero-copy cols {zero_copy_cols}, bulk-copy {bulk_copy_cols})")
+
+    telemetry.flush(trace_dir)
+    from dmlc_core_tpu.telemetry import traceview
+
+    merged = os.path.join(trace_dir, "merged.trace.json")
+    traceview.main(trace_dir, out=merged, as_json=False, top=10)
+    results["merged_trace"] = merged
+    if out_json:
+        with open(out_json, "w") as f:
+            json.dump(results, f, indent=2)
+        print(f"wrote {out_json}")
+    if not zero_copy_engaged:
+        print("ERROR: zero-copy ingest did NOT engage — the 'arrow_ingest' "
+              "number above includes bulk-copy column materialization "
+              f"(zero_copy={zero_copy_cols}, bulk_copy={bulk_copy_cols}, "
+              f"buffer_identity={buffer_identical})", file=sys.stderr)
+        raise SystemExit(1)
+    return results
+
+
 def gen(path, rows=1_000_000, features=28, fmt="libsvm"):
     """Synthetic HIGGS-like text file for benchmarking.
 
@@ -425,12 +647,14 @@ def bench_infeed(uri, record_bytes=600, batch=256):
 
 
 def main():
-    if len(sys.argv) < 3 and sys.argv[1:] != ["cache-ab"]:
-        print(__doc__)   # cache-ab is self-contained; everything else
-        return 2         # needs at least a URI/path argument
+    if len(sys.argv) < 3 and sys.argv[1:] not in (["cache-ab"],
+                                                  ["columnar-ab"]):
+        print(__doc__)   # the -ab harnesses are self-contained; everything
+        return 2         # else needs at least a URI/path argument
     cmd, args = sys.argv[1], sys.argv[2:]
     {"split": bench_split, "parser": bench_parser,
-     "parser-ab": bench_parser_ab, "cache-ab": bench_cache_ab, "gen": gen,
+     "parser-ab": bench_parser_ab, "cache-ab": bench_cache_ab,
+     "columnar-ab": bench_columnar_ab, "gen": gen,
      "genrec": genrec, "infeed": bench_infeed}[cmd](*args)
     return 0
 

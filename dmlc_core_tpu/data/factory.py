@@ -42,6 +42,39 @@ def _make_csv(source, args, nthread, index_dtype):
     return CSVParser(source, args=args, nthread=nthread, index_dtype=index_dtype)
 
 
+@parser_registry.register("parquet",
+                          description="columnar Parquet row groups, "
+                                      "zero-copy Arrow buffer -> RowBlock")
+def _make_parquet(uri, args, part_index, num_parts, nthread, index_dtype):
+    # lazy import: pyarrow is optional and its absence must only surface
+    # when a columnar source is actually requested (the HDFS gating pattern)
+    from dmlc_core_tpu.data.arrow_ingest import ParquetParser
+
+    return ParquetParser(uri, args=args, part_index=part_index,
+                         num_parts=num_parts, index_dtype=index_dtype)
+
+
+@parser_registry.register("arrow", aliases=["feather", "ipc"],
+                          description="Arrow IPC record batches, mmap'd "
+                                      "zero-copy views -> RowBlock")
+def _make_arrow_ipc(uri, args, part_index, num_parts, nthread, index_dtype):
+    from dmlc_core_tpu.data.arrow_ingest import ArrowIPCParser
+
+    return ArrowIPCParser(uri, args=args, part_index=part_index,
+                          num_parts=num_parts, index_dtype=index_dtype)
+
+
+# columnar formats consume the URI itself (footer + unit ranged reads)
+# instead of a newline-oriented InputSplit; sharding is by row group /
+# record batch
+_make_parquet.takes_uri = True
+_make_arrow_ipc.takes_uri = True
+
+# extension -> format when neither type= nor ?format= names one
+_COLUMNAR_EXTENSIONS = {".parquet": "parquet", ".arrow": "arrow",
+                        ".feather": "arrow", ".ipc": "arrow"}
+
+
 def create_parser(
     uri: str,
     part_index: int = 0,
@@ -53,18 +86,27 @@ def create_parser(
 ) -> Parser:
     """Create a parser (reference Parser<IndexType>::Create, src/data.cc:132-138).
 
-    ``type="auto"`` reads ``?format=`` from the URI, defaulting to libsvm.
-    The returned parser is wrapped in a :class:`ThreadedParser` prefetcher
+    ``type="auto"`` reads ``?format=`` from the URI; a bare
+    ``.parquet``/``.arrow``/``.feather`` path selects the columnar front
+    door, anything else defaults to libsvm (reference data.cc:70-76).  The
+    returned parser is wrapped in a :class:`ThreadedParser` prefetcher
     unless ``threaded=False``.
     """
     spec = URISpec(uri, part_index, num_parts)
     ptype = type
     if ptype == "auto":
-        ptype = spec.args.get("format", "libsvm")
+        ext = "." + spec.uri.rsplit(".", 1)[-1] if "." in spec.uri else ""
+        default = _COLUMNAR_EXTENSIONS.get(ext, "libsvm")
+        ptype = spec.args.get("format", default)
     entry = parser_registry[ptype]
-    split_uri = spec.uri + (f"#{spec.cache_file}" if spec.cache_file else "")
-    source = create_input_split(split_uri, part_index, num_parts, "text")
-    parser = entry(source, spec.args, nthread, np.dtype(index_dtype))
+    if getattr(entry.body, "takes_uri", False):
+        parser = entry(spec.uri, spec.args, part_index, num_parts, nthread,
+                       np.dtype(index_dtype))
+    else:
+        split_uri = spec.uri + (f"#{spec.cache_file}" if spec.cache_file
+                                else "")
+        source = create_input_split(split_uri, part_index, num_parts, "text")
+        parser = entry(source, spec.args, nthread, np.dtype(index_dtype))
     if threaded:
         return ThreadedParser(parser)
     return parser

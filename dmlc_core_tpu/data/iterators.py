@@ -243,28 +243,31 @@ class DiskRowIter(RowBlockIter):
         page = RowBlockContainer(self._index_dtype)
         page_bytes = 0
         total = 0
-        try:
-            for block in parser:
-                page.push_block(block)
-                page_bytes += block.memory_cost_bytes()
-                if page_bytes >= self._page_bytes:
+        with telemetry.span("cache.build", path=self._local_path) as sp:
+            try:
+                for block in parser:
+                    page.push_block(block)
+                    page_bytes += block.memory_cost_bytes()
+                    if page_bytes >= self._page_bytes:
+                        writer.write_page(page)
+                        total += page_bytes
+                        elapsed = max(get_time() - start, 1e-9)
+                        log_info(f"wrote {total >> 20} MB cache, "
+                                 f"{total / (1 << 20) / elapsed:.2f} MB/sec")
+                        page = RowBlockContainer(self._index_dtype)
+                        page_bytes = 0
+                if page.size:
                     writer.write_page(page)
-                    total += page_bytes
-                    elapsed = max(get_time() - start, 1e-9)
-                    log_info(f"wrote {total >> 20} MB cache, "
-                             f"{total / (1 << 20) / elapsed:.2f} MB/sec")
-                    page = RowBlockContainer(self._index_dtype)
-                    page_bytes = 0
-            if page.size:
-                writer.write_page(page)
-            writer.commit()
-        except BaseException:
-            # never leave a half-written file where a trusted cache goes
-            writer.abort()
-            raise
-        finally:
-            if hasattr(parser, "close"):
-                parser.close()
+                writer.commit()
+                sp.set(pages=writer.pages_written,
+                       nbytes=total + page_bytes)
+            except BaseException:
+                # never leave a half-written file where a trusted cache goes
+                writer.abort()
+                raise
+            finally:
+                if hasattr(parser, "close"):
+                    parser.close()
 
     # -- open -----------------------------------------------------------------
     def _open_cache(self) -> None:

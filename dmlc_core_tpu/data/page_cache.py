@@ -167,7 +167,20 @@ class PageCacheWriter:
 
     def write_page(self, container: RowBlockContainer) -> None:
         """Serialize one page (a RowBlockContainer worth of rows)."""
-        block = container.get_block()
+        self.write_block(container.get_block(),
+                         max_field=container.max_field,
+                         max_index=container.max_index)
+
+    def write_block(self, block: RowBlock, max_field: int = 0,
+                    max_index: int = 0) -> None:
+        """Serialize one RowBlock as a page, container-free.
+
+        The page serializer proper — :meth:`write_page` is a thin
+        container adapter over it.  Block producers whose pages arrive
+        already materialized (e.g. Arrow-mapped blocks from
+        ``arrow_ingest.table_to_block``) can call this directly instead
+        of re-staging through a RowBlockContainer; maxes default to the
+        block's own."""
         cols = self._col_arrays(block)
         payload = bytearray()
         for arr in cols:
@@ -175,9 +188,9 @@ class PageCacheWriter:
             payload += raw
             payload += b"\0" * (_align8(len(raw)) - len(raw))
         nnz = block.num_nonzero
-        max_field = container.max_field or (
+        max_field = max_field or (
             int(block.field.max()) if block.field is not None and nnz else 0)
-        max_index = container.max_index or (
+        max_index = max_index or (
             int(block.index.max()) if nnz else 0)
         meta = _PAGE_META.pack(len(payload), *(len(c) for c in cols),
                                max_field, max_index)

@@ -30,7 +30,9 @@ __all__ = ["Row", "RowBlock", "RowBlockContainer", "COLUMN_ORDER", "align8"]
 real_t = np.float32
 
 # canonical column transport/layout order shared by the shm parse transport
-# (data/parse_proc.py) and the columnar page cache (data/page_cache.py)
+# (data/parse_proc.py), the columnar page cache (data/page_cache.py), and
+# the Arrow/Parquet ingest (data/arrow_ingest.py), which maps Arrow
+# buffers onto exactly these columns as zero-copy views
 COLUMN_ORDER = ("offset", "label", "weight", "field", "index", "value")
 
 

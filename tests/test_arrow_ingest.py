@@ -1,0 +1,553 @@
+"""Zero-copy columnar ingest (ISSUE 13 tentpole, data/arrow_ingest.py):
+
+- **differential**: Arrow/Parquet ingest of a dataset produces
+  byte-identical RowBlock columns to the text parse of the same logical
+  data — dense (csv-equivalent, incl. NaN and null->missing cells) and
+  sparse (libsvm-equivalent, incl. weights) alike;
+- **zero-copy**: CSR columns are numpy views aliasing the Arrow buffers
+  (buffer identity, read-only), the accounting counters see every bulk
+  materialization, and ``DMLC_ARROW_REQUIRE_ZERO_COPY`` escalates any
+  bulk copy to an error;
+- **rejection, never drift**: wrong dtypes, nulls in sparse columns, and
+  misaligned list offsets raise :class:`ArrowIngestError` naming the
+  column — there is no silent cast or per-row fallback path;
+- **composition**: row-group sharding is exactly-once, DiskRowIter builds
+  (and publishes) the v2 page cache straight from Parquet row groups,
+  remote Parquet rides the ranged-read FS layer, and pyarrow stays an
+  optional dependency with one clear gating error.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from dmlc_core_tpu import telemetry  # noqa: E402
+from dmlc_core_tpu.data import arrow_ingest  # noqa: E402
+from dmlc_core_tpu.data.arrow_ingest import (ArrowIngestError,  # noqa: E402
+                                             table_to_block)
+from dmlc_core_tpu.data.factory import (create_parser,  # noqa: E402
+                                        create_row_block_iter)
+from dmlc_core_tpu.data.iterators import DiskRowIter  # noqa: E402
+from dmlc_core_tpu.data.row_block import concat_blocks  # noqa: E402
+from dmlc_core_tpu.io.ranged_read import RangedReadFile  # noqa: E402
+from tests.mock_s3 import MockS3  # noqa: E402
+
+ROWS = 3000
+
+
+# ------------------------------------------------------------------ corpora --
+
+def _sparse_data(rows=ROWS, seed=3, with_weight=False):
+    rng = np.random.RandomState(seed)
+    labels = (np.arange(rows) % 2).astype(np.float32)
+    weights = (rng.rand(rows).astype(np.float32) + np.float32(0.5)
+               if with_weight else None)
+    idx_lists, val_lists = [], []
+    for _ in range(rows):
+        feats = np.sort(rng.choice(40, size=rng.randint(1, 6),
+                                   replace=False)).astype(np.uint32)
+        idx_lists.append(feats)
+        val_lists.append(rng.rand(len(feats)).astype(np.float32))
+    return labels, weights, idx_lists, val_lists
+
+
+def _write_sparse_text(path, labels, weights, idx_lists, val_lists):
+    lines = []
+    for i, (idx, val) in enumerate(zip(idx_lists, val_lists)):
+        head = (f"{float(labels[i])!r}:{float(weights[i])!r}"
+                if weights is not None else f"{float(labels[i])!r}")
+        lines.append(head + " " + " ".join(
+            f"{j}:{float(v)!r}" for j, v in zip(idx, val)))
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _sparse_table(labels, weights, idx_lists, val_lists, list_type=None,
+                  index_type=None, value_type=None):
+    list_type = list_type or pa.large_list
+    cols = {
+        "label": pa.array(labels, type=pa.float32()),
+        "index": pa.array([[int(j) for j in idx] for idx in idx_lists],
+                          type=list_type(index_type or pa.uint32())),
+        "value": pa.array([[float(v) for v in val] for val in val_lists],
+                          type=list_type(value_type or pa.float32())),
+    }
+    if weights is not None:
+        cols["weight"] = pa.array(weights, type=pa.float32())
+    return pa.table(cols)
+
+
+def _write_parquet(path, table, row_group_size=700):
+    pq.write_table(table, str(path), row_group_size=row_group_size,
+                   compression="none", use_dictionary=False)
+    return str(path)
+
+
+def _write_ipc(path, table, batch_rows=700):
+    with pa.ipc.new_file(str(path), table.schema) as writer:
+        for batch in table.to_batches(max_chunksize=batch_rows):
+            writer.write_batch(batch)
+    return str(path)
+
+
+def _drain(uri, **kwargs):
+    parser = create_parser(uri, **kwargs)
+    blocks = list(parser)
+    if hasattr(parser, "close"):
+        parser.close()
+    return concat_blocks(blocks)
+
+
+def _assert_blocks_byte_identical(a, b, with_weight=False):
+    assert a.size == b.size
+    assert np.array_equal(a.offset - a.offset[0], b.offset - b.offset[0])
+    assert a.label.tobytes() == b.label.tobytes()
+    assert a.index.tobytes() == b.index.tobytes()
+    assert a.index.dtype == b.index.dtype
+    assert a.value.tobytes() == b.value.tobytes()
+    if with_weight:
+        assert a.weight.tobytes() == b.weight.tobytes()
+
+
+# -------------------------------------------------------------- differential --
+
+def test_sparse_parquet_byte_identical_to_libsvm(tmp_path):
+    labels, weights, idx, val = _sparse_data()
+    text = _write_sparse_text(tmp_path / "d.libsvm", labels, weights, idx,
+                              val)
+    parquet = _write_parquet(tmp_path / "d.parquet",
+                             _sparse_table(labels, weights, idx, val))
+    _assert_blocks_byte_identical(_drain(text, type="libsvm"),
+                                  _drain(parquet))
+
+
+def test_sparse_weights_byte_identical(tmp_path):
+    labels, weights, idx, val = _sparse_data(with_weight=True)
+    text = _write_sparse_text(tmp_path / "d.libsvm", labels, weights, idx,
+                              val)
+    parquet = _write_parquet(tmp_path / "d.parquet",
+                             _sparse_table(labels, weights, idx, val))
+    _assert_blocks_byte_identical(_drain(text, type="libsvm"),
+                                  _drain(parquet), with_weight=True)
+
+
+def test_sparse_arrow_ipc_byte_identical(tmp_path):
+    labels, weights, idx, val = _sparse_data()
+    text = _write_sparse_text(tmp_path / "d.libsvm", labels, weights, idx,
+                              val)
+    ipc = _write_ipc(tmp_path / "d.arrow",
+                     _sparse_table(labels, weights, idx, val))
+    _assert_blocks_byte_identical(_drain(text, type="libsvm"), _drain(ipc))
+
+
+def _dense_data(rows=ROWS, feats=9, seed=5):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(rows, feats).astype(np.float32)
+    x[rows // 3, 2] = np.float32("nan")          # a real NaN VALUE
+    y = rng.randint(0, 2, rows).astype(np.float32)
+    missing_at = (rows // 2, 4)                  # a MISSING cell (null)
+    return x, y, missing_at
+
+
+def test_dense_parquet_byte_identical_to_csv(tmp_path):
+    x, y, (mi, mj) = _dense_data()
+    csv = tmp_path / "d.csv"
+    with open(csv, "w") as f:
+        for i, (yi, row) in enumerate(zip(y, x)):
+            cells = [repr(float(v)) for v in row]
+            if i == mi:
+                cells[mj] = ""                   # empty cell -> ?missing=
+            f.write(repr(float(yi)) + "," + ",".join(cells) + "\n")
+    cols = {"label": pa.array(y, type=pa.float32())}
+    for j in range(x.shape[1]):
+        col = x[:, j].tolist()
+        if j == mj:
+            col[mi] = None                       # null cell -> ?missing=
+        cols[f"f{j}"] = pa.array(col, type=pa.float32())
+    parquet = _write_parquet(tmp_path / "d.parquet", pa.table(cols))
+    for missing in ("0.0", "nan"):
+        a = _drain(f"{csv}?format=csv&label_column=0&missing={missing}")
+        b = _drain(f"{parquet}?label_column=0&missing={missing}")
+        # tobytes compares bit patterns, so NaNs must match exactly too
+        _assert_blocks_byte_identical(a, b)
+
+
+def test_dense_named_label_column_default(tmp_path):
+    x, y, _ = _dense_data(rows=100)
+    cols = {f"f{j}": pa.array(x[:, j], type=pa.float32())
+            for j in range(x.shape[1])}
+    cols["label"] = pa.array(y, type=pa.float32())
+    parquet = _write_parquet(tmp_path / "d.parquet", pa.table(cols))
+    block = _drain(parquet)                      # no label_column given
+    assert block.label.tobytes() == y.tobytes()
+    assert block.size == 100
+
+
+def test_empty_row_groups_skipped(tmp_path):
+    schema = pa.schema([("label", pa.float32()),
+                        ("index", pa.large_list(pa.uint32()))])
+    path = str(tmp_path / "e.parquet")
+    with pq.ParquetWriter(path, schema) as writer:
+        writer.write_table(pa.table({"label": pa.array([], pa.float32()),
+                                     "index": pa.array(
+                                         [], pa.large_list(pa.uint32()))}))
+        writer.write_table(pa.table({"label": pa.array([1.0], pa.float32()),
+                                     "index": pa.array(
+                                         [[3]], pa.large_list(pa.uint32()))}))
+    parser = create_parser(path, threaded=False)
+    blocks = list(parser)
+    parser.close()
+    assert [b.size for b in blocks] == [1]
+    assert blocks[0].index.tolist() == [3]
+
+
+def test_row_group_sharding_exactly_once(tmp_path):
+    labels, weights, idx, val = _sparse_data(rows=1000)
+    parquet = _write_parquet(tmp_path / "d.parquet",
+                             _sparse_table(labels, weights, idx, val),
+                             row_group_size=128)
+    whole = _drain(parquet)
+    parts = [_drain(parquet, part_index=k, num_parts=3) for k in range(3)]
+    assert sum(p.size for p in parts) == whole.size == 1000
+    # shard k of n reads row groups k, k+n, ... — concatenating the parts
+    # in round-robin group order reproduces the whole dataset exactly
+    merged = concat_blocks([blk for blk in _interleave(parts, parquet)])
+    assert merged.label.tobytes() == whole.label.tobytes()
+    assert merged.value.tobytes() == whole.value.tobytes()
+
+
+def _interleave(parts, parquet):
+    """Re-drain per part as block lists to reassemble round-robin."""
+    out = []
+    lists = []
+    for k in range(len(parts)):
+        parser = create_parser(parquet, part_index=k, num_parts=len(parts),
+                               threaded=False)
+        lists.append(list(parser))
+        parser.close()
+    longest = max(len(lst) for lst in lists)
+    for i in range(longest):
+        for lst in lists:
+            if i < len(lst):
+                out.append(lst[i])
+    return out
+
+
+# ----------------------------------------------------- rejection, not drift --
+
+def test_dense_float64_feature_rejected(tmp_path):
+    table = pa.table({"label": pa.array([1.0, 0.0], pa.float32()),
+                      "f0": pa.array([1.0, 2.0], pa.float64())})
+    path = _write_parquet(tmp_path / "drift.parquet", table)
+    with pytest.raises(ArrowIngestError, match="f0.*double|double.*f0"):
+        _drain(path, threaded=False)
+
+
+def test_sparse_value_float64_rejected(tmp_path):
+    labels, weights, idx, val = _sparse_data(rows=50)
+    table = _sparse_table(labels, weights, idx, val,
+                          value_type=pa.float64())
+    path = _write_parquet(tmp_path / "drift.parquet", table)
+    with pytest.raises(ArrowIngestError, match="value"):
+        _drain(path, threaded=False)
+
+
+def test_sparse_index_dtype_drift_rejected(tmp_path):
+    labels, weights, idx, val = _sparse_data(rows=50)
+    table = _sparse_table(labels, weights, idx, val,
+                          index_type=pa.int64())
+    path = _write_parquet(tmp_path / "drift.parquet", table)
+    with pytest.raises(ArrowIngestError, match="index"):
+        _drain(path, threaded=False)
+    # ... but an int64 index column IS the right dtype for an int64 cache
+    block = _drain(path, threaded=False, index_dtype=np.int64)
+    assert block.index.dtype == np.dtype(np.int64)
+
+
+def test_misaligned_value_lists_rejected(tmp_path):
+    table = pa.table({
+        "label": pa.array([0.0, 1.0], pa.float32()),
+        "index": pa.array([[0, 1], [2]], pa.large_list(pa.uint32())),
+        "value": pa.array([[1.0], [2.0]], pa.large_list(pa.float32())),
+    })
+    path = _write_parquet(tmp_path / "mis.parquet", table)
+    with pytest.raises(ArrowIngestError, match="row lengths"):
+        _drain(path, threaded=False)
+
+
+def test_null_sparse_row_rejected(tmp_path):
+    table = pa.table({
+        "label": pa.array([0.0, 1.0], pa.float32()),
+        "index": pa.array([[0, 1], None], pa.large_list(pa.uint32())),
+    })
+    path = _write_parquet(tmp_path / "null.parquet", table)
+    with pytest.raises(ArrowIngestError, match="null"):
+        _drain(path, threaded=False)
+
+
+def test_list_without_index_column_rejected(tmp_path):
+    table = pa.table({
+        "label": pa.array([0.0], pa.float32()),
+        "vals": pa.array([[1.0]], pa.large_list(pa.float32())),
+    })
+    path = _write_parquet(tmp_path / "noindex.parquet", table)
+    with pytest.raises(ArrowIngestError, match="index"):
+        _drain(path, threaded=False)
+
+
+# ------------------------------------------------------- zero-copy evidence --
+
+def test_ipc_views_alias_arrow_buffers_and_are_readonly(tmp_path):
+    labels, weights, idx, val = _sparse_data(rows=400, with_weight=True)
+    ipc = _write_ipc(tmp_path / "d.arrow",
+                     _sparse_table(labels, weights, idx, val),
+                     batch_rows=400)
+    mm = pa.memory_map(ipc)
+    table = pa.Table.from_batches([pa.ipc.open_file(mm).get_batch(0)])
+    block, stats = table_to_block(table)
+    assert stats["bulk_copy_columns"] == 0
+    assert stats["zero_copy_columns"] >= 6   # label/weight + 2x(offsets+values)
+    for name in ("offset", "label", "weight", "index", "value"):
+        arr = getattr(block, name)
+        assert not arr.flags.writeable, name
+        assert not arr.flags.owndata, name   # a view, not a materialization
+    # buffer identity against the Arrow child buffers themselves
+    child = table.column("value").chunk(0).values
+    arrow_view = np.frombuffer(child.buffers()[1], dtype=np.float32,
+                               count=len(child) + child.offset)
+    assert np.shares_memory(block.value, arrow_view)
+    idx_child = table.column("index").chunk(0).values
+    idx_view = np.frombuffer(idx_child.buffers()[1], dtype=np.uint32,
+                             count=len(idx_child) + idx_child.offset)
+    assert np.shares_memory(block.index, idx_view)
+
+
+def test_plain_list_offsets_counted_as_bulk_copy(tmp_path, monkeypatch):
+    labels, weights, idx, val = _sparse_data(rows=50)
+    table = _sparse_table(labels, weights, idx, val, list_type=pa.list_)
+    block, stats = table_to_block(table)
+    assert block.size == 50
+    # 32-bit list offsets widen to CSR int64: visible, never silent
+    assert stats["bulk_copy_columns"] >= 1
+    assert any("offsets" in r for r in stats["bulk_copy_reasons"])
+    monkeypatch.setenv("DMLC_ARROW_REQUIRE_ZERO_COPY", "1")
+    with pytest.raises(ArrowIngestError, match="REQUIRE_ZERO_COPY"):
+        table_to_block(table)
+
+
+def test_strict_knob_rejects_dense_interleave(tmp_path, monkeypatch):
+    x, y, _ = _dense_data(rows=20)
+    cols = {"label": pa.array(y, pa.float32())}
+    for j in range(x.shape[1]):
+        cols[f"f{j}"] = pa.array(x[:, j], pa.float32())
+    table = pa.table(cols)
+    block, stats = table_to_block(table, label_column=0)
+    assert stats["bulk_copy_columns"] == 1   # exactly the interleave
+    monkeypatch.setenv("DMLC_ARROW_REQUIRE_ZERO_COPY", "1")
+    with pytest.raises(ArrowIngestError, match="interleave"):
+        table_to_block(table, label_column=0)
+
+
+def test_ingest_telemetry_counters(tmp_path):
+    labels, weights, idx, val = _sparse_data(rows=500)
+    parquet = _write_parquet(tmp_path / "d.parquet",
+                             _sparse_table(labels, weights, idx, val))
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        reg = telemetry.get_registry()
+        rows_c = reg.counter("dmlc_ingest_rows_total", format="parquet")
+        zc = reg.counter("dmlc_ingest_columns_total", mode="zero_copy")
+        bc = reg.counter("dmlc_ingest_columns_total", mode="bulk_copy")
+        r0, z0, b0 = rows_c.value, zc.value, bc.value
+        block = _drain(parquet, threaded=False)
+        assert block.size == 500
+        assert rows_c.value - r0 == 500
+        assert zc.value > z0
+        assert bc.value == b0        # large_list sparse: pure views
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+# ------------------------------------------------- page cache + remote paths --
+
+def test_page_cache_from_parquet_epoch2_buffer_identity(tmp_path):
+    labels, weights, idx, val = _sparse_data()
+    text = _write_sparse_text(tmp_path / "d.libsvm", labels, weights, idx,
+                              val)
+    parquet = _write_parquet(tmp_path / "d.parquet",
+                             _sparse_table(labels, weights, idx, val))
+    it = create_row_block_iter(f"{parquet}#{tmp_path / 'c.cache'}")
+    assert isinstance(it, DiskRowIter)
+    epoch1 = list(it)
+    it.before_first()
+    epoch2 = list(it)
+    assert sum(b.size for b in epoch1) == ROWS == sum(b.size for b in epoch2)
+    for a, b in zip(epoch1, epoch2):
+        assert a.offset is b.offset          # the same mmap views per epoch
+        assert a.index is b.index
+        assert a.value is b.value
+        assert not a.index.flags.writeable
+    it.close()
+    # and the cached columns equal the text parse of the same logical data
+    cached = concat_blocks(epoch1)
+    _assert_blocks_byte_identical(_drain(text, type="libsvm"), cached)
+
+
+def test_write_block_direct_arrow_to_page_cache(tmp_path):
+    """Arrow-mapped blocks write straight into a v2 cache via
+    ``PageCacheWriter.write_block`` — no RowBlockContainer re-staging —
+    and the reader serves them back column-identical."""
+    from dmlc_core_tpu.data import page_cache
+
+    labels, weights, idx, val = _sparse_data(rows=300, with_weight=True)
+    table = _sparse_table(labels, weights, idx, val)
+    block, stats = table_to_block(table)
+    assert stats["bulk_copy_columns"] == 0
+    cache = str(tmp_path / "direct.cache")
+    writer = page_cache.PageCacheWriter(cache)
+    writer.write_block(block)
+    writer.commit()
+    reader = page_cache.PageCacheReader(cache)
+    [served] = reader.blocks
+    assert served.label.tobytes() == block.label.tobytes()
+    assert served.index.tobytes() == block.index.tobytes()
+    assert served.value.tobytes() == block.value.tobytes()
+    assert served.weight.tobytes() == block.weight.tobytes()
+    assert np.array_equal(served.offset, block.offset)
+    reader.close()
+
+
+def test_fit_binner_over_parquet_cache_views(tmp_path):
+    """The streamed-quantile feed consumes the parquet-built cache's mmap
+    views directly — the full zero-copy chain parquet -> page cache ->
+    binner edges with no text stage anywhere."""
+    from dmlc_core_tpu.bridge.binning import fit_binner
+
+    x = np.random.RandomState(7).randn(800, 4).astype(np.float32)
+    cols = {"label": pa.array(np.zeros(800, np.float32), pa.float32())}
+    for j in range(4):
+        cols[f"f{j}"] = pa.array(x[:, j], pa.float32())
+    parquet = _write_parquet(tmp_path / "d.parquet", pa.table(cols))
+    it = create_row_block_iter(f"{parquet}#{tmp_path / 'c.cache'}")
+    list(it)
+    blocks = it.cache_blocks()
+    assert blocks is not None
+    binner = fit_binner(blocks, num_bins=16, num_feature=4)
+    direct = fit_binner(x, num_bins=16, num_feature=4)
+    for a, b in zip(binner.boundaries, direct.boundaries):
+        assert np.allclose(a, b)
+    it.close()
+
+
+@pytest.fixture()
+def mock_s3(monkeypatch, tmp_path):
+    server = MockS3().start()
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "test-key")
+    monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "test-secret")
+    monkeypatch.setenv("AWS_REGION", "us-east-1")
+    monkeypatch.setenv("S3_ENDPOINT", f"http://127.0.0.1:{server.port}")
+    monkeypatch.setenv("DMLC_CACHE_LOCAL_DIR", str(tmp_path / "materialized"))
+    monkeypatch.delenv("DMLC_CACHE_REMOTE", raising=False)
+    yield server
+    server.stop()
+
+
+def test_remote_parquet_ranged_reads(mock_s3, tmp_path):
+    labels, weights, idx, val = _sparse_data(rows=800)
+    parquet = _write_parquet(tmp_path / "d.parquet",
+                             _sparse_table(labels, weights, idx, val),
+                             row_group_size=100)
+    with open(parquet, "rb") as f:
+        mock_s3.objects[("bucket", "d.parquet")] = f.read()
+    local = _drain(parquet)
+    remote = _drain("s3://bucket/d.parquet")
+    _assert_blocks_byte_identical(local, remote)
+    # sharded remote read: only the assigned row groups move
+    part0 = _drain("s3://bucket/d.parquet", part_index=0, num_parts=2)
+    part1 = _drain("s3://bucket/d.parquet", part_index=1, num_parts=2)
+    assert part0.size + part1.size == 800
+
+
+def test_remote_parquet_to_published_cache_fleet_fetch(mock_s3, tmp_path,
+                                                      monkeypatch):
+    """The full ISSUE 13 composition: a cold worker ingests remote Parquet
+    (no text anywhere), builds the v2 page cache from its row groups, and
+    publishes it; a second host fetches the published cache instead of
+    touching the Parquet object at all."""
+    import shutil
+
+    labels, weights, idx, val = _sparse_data(rows=600)
+    parquet = _write_parquet(tmp_path / "d.parquet",
+                             _sparse_table(labels, weights, idx, val))
+    with open(parquet, "rb") as f:
+        mock_s3.objects[("bucket", "d.parquet")] = f.read()
+    monkeypatch.setenv("DMLC_CACHE_REMOTE", "1")
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        reg = telemetry.get_registry()
+        hits = reg.counter("dmlc_cache_remote_hits_total")
+        publishes = reg.counter("dmlc_cache_remote_publishes_total")
+        h0, p0 = hits.value, publishes.value
+        uri = "s3://bucket/d.parquet#s3://bucket/caches/d.rbc"
+        it = create_row_block_iter(uri)
+        assert sum(b.size for b in it) == 600
+        it.close()
+        assert publishes.value == p0 + 1
+        assert ("bucket", "caches/d.rbc") in mock_s3.objects
+
+        # second "host": fresh local dir, fetches the cache, parquet unread
+        shutil.rmtree(str(tmp_path / "materialized"), ignore_errors=True)
+        del mock_s3.objects[("bucket", "d.parquet")]   # prove it: source gone
+        it2 = create_row_block_iter(uri)
+        assert sum(b.size for b in it2) == 600
+        it2.close()
+        assert hits.value == h0 + 1
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+# ------------------------------------------------------------ io + gating ----
+
+def test_ranged_read_file_semantics(tmp_path):
+    path = tmp_path / "blob.bin"
+    payload = bytes(range(256)) * 16
+    path.write_bytes(payload)
+    with RangedReadFile(str(path)) as f:
+        assert f.size() == len(payload)
+        assert f.read(4) == payload[:4]
+        assert f.tell() == 4
+        assert f.seek(-8, 2) == len(payload) - 8
+        assert f.read() == payload[-8:]
+        assert f.seek(2, 0) == 2
+        assert f.seek(3, 1) == 5
+        assert f.read(1) == payload[5:6]
+        f.seek(len(payload) + 100)
+        assert f.read(10) == b""             # past EOF: empty, not an error
+        with pytest.raises(ValueError):
+            f.seek(0, 9)
+    with pytest.raises(ValueError, match="closed"):
+        f.read(1)
+
+
+def test_pyarrow_absent_raises_one_clear_error(tmp_path, monkeypatch):
+    monkeypatch.setattr(arrow_ingest, "pa", None)
+    monkeypatch.setattr(arrow_ingest, "pq", None)
+    monkeypatch.setattr(arrow_ingest, "_PYARROW_ERROR",
+                        ImportError("No module named 'pyarrow'"))
+    assert not arrow_ingest.pyarrow_available()
+    with pytest.raises(RuntimeError, match="pyarrow"):
+        create_parser(str(tmp_path / "d.parquet"))
+    with pytest.raises(RuntimeError, match="pyarrow"):
+        arrow_ingest.ParquetParser(str(tmp_path / "d.parquet"))
+    # ... and the text front door is untouched by the absence
+    (tmp_path / "t.libsvm").write_text("1 0:1.5\n")
+    block = _drain(str(tmp_path / "t.libsvm"), type="libsvm")
+    assert block.size == 1
