@@ -41,8 +41,17 @@ from dmlc_core_tpu.ops.histogram import (local_quantile_summary,
                                          merged_quantile_boundaries)
 from dmlc_core_tpu.utils.logging import CHECK
 
-__all__ = ["HostBinner", "BinnedBatch", "fit_binner", "binned_batches",
-           "wire_dtype"]
+__all__ = ["HostBinner", "BinnedBatch", "fit_binner",
+           "fit_binner_from_summaries", "default_summary_points",
+           "binned_batches", "wire_dtype"]
+
+
+def default_summary_points(num_bins: int) -> int:
+    """Per-chunk summary resolution K for ``num_bins`` target bins — the
+    single formula both :func:`fit_binner` and any external summary
+    producer (the fleet-ingest workers) must share for their summaries to
+    merge into identical edges."""
+    return max(64, 8 * num_bins)
 
 
 def wire_dtype(num_bins: int) -> np.dtype:
@@ -203,8 +212,7 @@ def fit_binner(source: Any, num_bins: int,
     ``handle_missing`` reserves the last bin id for NaN (GBDT
     sparsity-aware contract): edges then cover ``num_bins - 1`` real bins.
     """
-    eff_bins = num_bins - 1 if handle_missing else num_bins
-    K = num_points or max(64, 8 * num_bins)
+    K = num_points or default_summary_points(num_bins)
     all_points, all_counts = [], []
     n_feat = None
     for chunk in _dense_chunks(source, num_feature, handle_missing):
@@ -216,8 +224,33 @@ def fit_binner(source: Any, num_bins: int,
         all_points.append(pts)
         all_counts.append(cnt)
     CHECK(all_points, "fit_binner: empty source (no rows to summarise)")
-    points = np.stack(all_points)                        # [C, F, K]
-    counts = np.stack(all_counts)                        # [C, F]
+    return fit_binner_from_summaries(
+        np.stack(all_points), np.stack(all_counts), num_bins,
+        handle_missing=handle_missing, comm=comm, num_points=K)
+
+
+def fit_binner_from_summaries(points: np.ndarray, counts: np.ndarray,
+                              num_bins: int, *,
+                              handle_missing: bool = False, comm=None,
+                              num_points: Optional[int] = None) -> HostBinner:
+    """The allgather-merge tail of :func:`fit_binner`, callable on
+    pre-accumulated ``local_quantile_summary`` stacks.
+
+    ``points [C, F, K]`` / ``counts [C, F]`` are this rank's per-chunk
+    summaries (K must be :func:`default_summary_points` of ``num_bins``
+    unless ``num_points`` overrides it, and every participating rank must
+    use the same K).  With ``comm`` the local stack is re-summarised to one
+    fixed ``[F, K]`` block, allgathered, and merged globally — every rank
+    returns bitwise-identical boundaries.  This is how the fleet-ingest
+    workers (:mod:`dmlc_core_tpu.parallel.fleet_ingest`) fit one
+    cross-rank-consistent binner over dynamically-assigned unit sets:
+    summaries accumulate per unit during ingest, and the rank's final
+    merge goes through exactly this path.
+    """
+    eff_bins = num_bins - 1 if handle_missing else num_bins
+    K = num_points or default_summary_points(num_bins)
+    points = np.asarray(points, dtype=np.float32)
+    counts = np.asarray(counts, dtype=np.float32)
     if comm is not None:
         local = _resummarize(points, counts, K)          # [F, K]
         local_mass = counts.sum(axis=0).astype(np.float32)

@@ -22,6 +22,9 @@ Injection sites (see :data:`SITES`):
 - ``threadediter.produce`` — the producer thread, per item;
 - ``data.parse_worker``    — process-pool parse workers, per sub-range
   (``exit`` = kill a worker mid-chunk);
+- ``io.fleet.lease``       — fleet-ingest lease client, per wire op
+  (``exit`` with ``match {"op": "commit"}`` = kill a worker mid-unit,
+  after processing but before its commit lands — the reassignment drill);
 - ``serve.request`` / ``serve.queue`` / ``serve.predict`` — the scoring
   service's ingress, batch assembly, and model call (docs/serving.md).
 
@@ -94,6 +97,13 @@ SITES: Dict[str, str] = {
         "(ctx: parser=<class>); 'exit' kills the worker mid-chunk.  "
         "Workers read DMLC_FAULT_PLAN at start: the shared pool must be "
         "(re)started after setting the plan (data.parse_proc.shutdown())"),
+    "io.fleet.lease": (
+        "fleet-ingest shard-lease client, once per wire op before it runs "
+        "(ctx: op=acquire|renew|commit, worker=<id>); 'delay' models a "
+        "straggling rank, 'reset' a flaky control link (the client "
+        "retries), and 'exit' with match op=commit kills a worker mid-unit "
+        "— the lease expires and the unit must be reassigned with "
+        "exactly-once coverage (docs/performance.md \"Fleet ingest\")"),
     "serve.request": (
         "scoring HTTP ingress, once per POST /v1/score before parsing; "
         "'http_status' REPLACES the response (the chaos 503 storm), "

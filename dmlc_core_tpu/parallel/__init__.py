@@ -1,15 +1,36 @@
-"""Device-mesh construction and sharding helpers.
+"""Device-mesh construction, sharding helpers, and fleet-scale ingest.
 
 This layer replaces the reference tracker's tree/ring topology machinery
 (tracker/dmlc_tracker/tracker.py:165-252): on TPU the torus topology is
 hardware (ICI), so "topology awareness" surfaces as `jax.sharding.Mesh`
 construction + NamedShardings, and the collectives ride ICI/DCN via XLA.
+
+:mod:`.fleet_ingest` is the host-side half of the fleet story: dynamic
+work-stealing shard leases over the tracker control plane (see
+docs/performance.md "Fleet ingest").  The mesh helpers import ``jax``;
+``fleet_ingest`` is numpy-only — the names below resolve lazily (PEP 562)
+so a spawned ingest worker importing this package never pays the jax
+bring-up.
 """
 
-from dmlc_core_tpu.parallel.mesh import (  # noqa: F401
-    make_mesh,
-    make_hybrid_mesh,
-    data_sharding,
-    replicated_sharding,
-    local_shard_info,
+_MESH_EXPORTS = (
+    "make_mesh",
+    "make_hybrid_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "local_shard_info",
 )
+
+__all__ = list(_MESH_EXPORTS) + ["fleet_ingest"]
+
+
+def __getattr__(name):
+    if name in _MESH_EXPORTS:
+        from dmlc_core_tpu.parallel import mesh
+
+        return getattr(mesh, name)
+    if name == "fleet_ingest":
+        import importlib
+
+        return importlib.import_module("dmlc_core_tpu.parallel.fleet_ingest")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,598 @@
+"""Fleet ingest: tracker shard-lease coordinator + work-stealing workers.
+
+Covers the control plane (lease grant/renew/commit/expiry-reassignment,
+protocol hardening), the worker loop (exactly-once row accounting, commit
+rejection after a lease moved), the cross-rank-consistent binner fit over
+disjoint unit sets, and — chaos-marked — a worker killed mid-unit under
+the committed ``benchmarks/fleet_fault_plan.json`` with an every-row-
+exactly-once ledger check against ground-truth row ids.
+"""
+
+import functools
+import json
+import multiprocessing
+import operator
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.parallel import fleet_ingest
+from dmlc_core_tpu.tracker.rendezvous import (LEASE_MAGIC, FramedSocket,
+                                              ShardLeaseCoordinator,
+                                              TrackerError)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEET_PLAN = os.path.join(REPO, "benchmarks", "fleet_fault_plan.json")
+
+ROWS = 2000
+FEATURES = 5
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    """libsvm corpus whose LABEL is the row id — the ground truth the
+    exactly-once ledger checks reconcile against."""
+    path = tmp_path / "fleet.libsvm"
+    rng = np.random.RandomState(0)
+    with open(path, "w") as f:
+        for i in range(ROWS):
+            feats = " ".join(f"{j}:{rng.randn():.4f}"
+                             for j in range(FEATURES))
+            f.write(f"{i} {feats}\n")
+    return str(path)
+
+
+def _units(corpus, num_workers=2, **kwargs):
+    kwargs.setdefault("fmt", "libsvm")
+    kwargs.setdefault("ledger_labels", True)
+    return fleet_ingest.plan_units(corpus, num_workers, **kwargs)
+
+
+def _check_exactly_once(ledger, rows=ROWS):
+    """Every row id seen exactly once across all committed units."""
+    got = sum(e["rows"] for e in ledger.values())
+    id_sum = sum(e["payload"]["id_sum"] for e in ledger.values())
+    id_xor = 0
+    for e in ledger.values():
+        id_xor ^= e["payload"]["id_xor"]
+    assert got == rows, f"row count {got} != {rows}"
+    assert id_sum == rows * (rows - 1) // 2, "row-id sum off: lost/dup rows"
+    assert id_xor == functools.reduce(operator.xor, range(rows)), \
+        "row-id xor off: lost/dup rows"
+
+
+# -- unit planning ------------------------------------------------------------
+
+def test_plan_units_partitions_and_defaults(corpus, monkeypatch):
+    units = _units(corpus, num_workers=3)
+    assert len(units) == 24  # 3 * DMLC_FLEET_UNITS_PER_WORKER default 8
+    specs = [json.loads(u) for u in units]
+    assert [s["part"] for s in specs] == list(range(24))
+    assert all(s["nparts"] == 24 and s["uri"] == corpus for s in specs)
+    monkeypatch.setenv("DMLC_FLEET_UNITS_PER_WORKER", "2")
+    assert len(_units(corpus, num_workers=3)) == 6
+    assert len(_units(corpus, num_workers=3, num_units=5)) == 5
+
+
+def test_units_cover_input_exactly_once(corpus):
+    """Draining every unit's shard independently yields every row once —
+    the byte-range partition property the lease ledger builds on."""
+    units = _units(corpus, num_workers=2, num_units=7)
+    ids = []
+    for spec_json in units:
+        spec = json.loads(spec_json)
+        payload = fleet_ingest.default_unit_processor(spec)
+        ids.append((payload["rows"], payload["id_sum"]))
+    assert sum(r for r, _ in ids) == ROWS
+    assert sum(s for _, s in ids) == ROWS * (ROWS - 1) // 2
+
+
+# -- dynamic scheduling happy path -------------------------------------------
+
+def test_dynamic_two_workers_exactly_once(corpus):
+    units = _units(corpus, num_workers=2, num_units=8)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=5.0)
+    coord.start()
+    results = {}
+
+    def work(i):
+        results[i] = fleet_ingest.run_worker(
+            f"w{i}", "127.0.0.1", coord.port, lease_timeout=5.0)
+
+    try:
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        ledger = coord.result(timeout=10)
+    finally:
+        coord.stop()
+    _check_exactly_once(ledger)
+    assert coord.committed_total == 8
+    assert coord.reassigned_total == 0
+    assert sum(r.rows for r in results.values()) == ROWS
+    assert sum(r.units_committed for r in results.values()) == 8
+    # the ledger attributes every unit to the worker that committed it
+    assert {e["worker"] for e in ledger.values()} <= {"w0", "w1"}
+
+
+def test_static_mode_residue_discipline(corpus):
+    """Static k%n through the same wire path: each worker only ever gets
+    its own residue, and -2 means ITS residue is done."""
+    units = _units(corpus, num_workers=2, num_units=6)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, mode="static",
+                                  world_size=2, lease_timeout=5.0)
+    coord.start()
+    try:
+        r0 = fleet_ingest.run_worker("w0", "127.0.0.1", coord.port,
+                                     worker_index=0, lease_timeout=5.0)
+        # w1's residue is untouched by w0 having finished
+        done, total = coord.coverage()
+        assert (done, total) == (3, 6)
+        assert sorted(r0.unit_ids) == [0, 2, 4]
+        r1 = fleet_ingest.run_worker("w1", "127.0.0.1", coord.port,
+                                     worker_index=1, lease_timeout=5.0)
+        assert sorted(r1.unit_ids) == [1, 3, 5]
+        ledger = coord.result(timeout=5)
+    finally:
+        coord.stop()
+    _check_exactly_once(ledger)
+
+
+# -- lease expiry / reassignment / exactly-once rejection ---------------------
+
+def test_lease_expiry_reassignment_and_commit_rejection(corpus):
+    """Regression for the reassignment core: a lease whose holder stops
+    heartbeating expires and moves; the old holder's late commit is
+    rejected; the unit is committed exactly once."""
+    units = _units(corpus, num_workers=2, num_units=2)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=0.3)
+    coord.start()
+    try:
+        dead = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "dead")
+        unit_id, spec = dead.acquire()
+        assert unit_id >= 0 and spec
+        # no heartbeat: the lease expires and the next asker steals it
+        time.sleep(0.5)
+        thief = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "thief")
+        stolen_id, stolen_spec = thief.acquire()
+        assert stolen_id == unit_id
+        assert coord.reassigned_total == 1
+        assert "dead" in coord.failed_workers
+        # the dead worker's late commit must be rejected...
+        assert dead.commit(unit_id, {"rows": 11}) is False
+        assert coord.rejected_total == 1
+        # ...and the new holder's accepted — exactly once
+        assert thief.commit(unit_id, {"rows": 11}) is True
+        assert coord.committed_total == 1
+        assert coord.ledger()[unit_id]["worker"] == "thief"
+        # idempotent retry from the committed holder is acked, not doubled
+        assert thief.commit(unit_id, {"rows": 11}) is True
+        assert coord.committed_total == 1
+    finally:
+        coord.stop()
+
+
+def test_acquire_retry_redelivers_held_lease(corpus):
+    """Regression: a lost grant reply makes the client retry acquire.  The
+    retry must get the SAME unit back — a fresh grant would orphan the
+    held lease, which the renew-all heartbeat then keeps alive forever
+    and the epoch never completes."""
+    units = _units(corpus, num_workers=1, num_units=3)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=5.0)
+    coord.start()
+    try:
+        client = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "w0")
+        first, spec1 = client.acquire()
+        # the client never saw the reply (lost) and retries: same unit
+        again, spec2 = client.acquire()
+        assert (again, spec2) == (first, spec1)
+        assert coord.assigned_total == 1  # one grant, re-delivered
+        assert client.commit(first, {"rows": 1}) is True
+        # after the commit the next acquire moves on to a new unit
+        nxt, _ = client.acquire()
+        assert nxt not in (-1, -2) and nxt != first
+    finally:
+        coord.stop()
+
+
+def test_renew_keeps_lease_alive(corpus):
+    units = _units(corpus, num_workers=1, num_units=1)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=0.4)
+    coord.start()
+    try:
+        holder = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "holder")
+        unit_id, _ = holder.acquire()
+        for _ in range(4):
+            time.sleep(0.2)
+            assert holder.renew() == 1
+        other = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "other")
+        assert other.acquire()[0] == -1  # still held — heartbeats worked
+        assert holder.commit(unit_id, {"rows": 5}) is True
+        assert other.acquire()[0] == -2
+        assert coord.reassigned_total == 0
+    finally:
+        coord.stop()
+
+
+def test_static_mode_never_steals(corpus):
+    units = _units(corpus, num_workers=2, num_units=2)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, mode="static",
+                                  world_size=2, lease_timeout=0.2)
+    coord.start()
+    try:
+        dead = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "dead")
+        unit_id, _ = dead.acquire(worker_index=0)
+        assert unit_id == 0
+        time.sleep(0.4)  # expired — but static mode must not reassign
+        other = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "other")
+        assert other.acquire(worker_index=1)[0] == 1
+        assert other.commit(1, {"rows": 3}) is True
+        assert other.acquire(worker_index=1)[0] == -2
+        assert coord.reassigned_total == 0
+        # the dead residue stays uncovered: result() must say so loudly
+        with pytest.raises(TrackerError, match="incomplete"):
+            coord.result(timeout=0.2)
+    finally:
+        coord.stop()
+
+
+# -- protocol hardening -------------------------------------------------------
+
+def test_bad_magic_rejected_coordinator_survives(corpus):
+    units = _units(corpus, num_workers=1, num_units=1)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=5.0,
+                                  sock_timeout=1.0)
+    coord.start()
+    try:
+        with socket.create_connection(("127.0.0.1", coord.port)) as sock:
+            sock.sendall(struct.pack("@i", 0xBEEF))
+            # server rejects and closes; we observe EOF, not a hang
+            sock.settimeout(2.0)
+            assert sock.recv(4) == b""
+        # hostile frame: magic ok then an unknown command
+        with socket.create_connection(("127.0.0.1", coord.port)) as sock:
+            sk = FramedSocket(sock, timeout=2.0)
+            sk.sendint(LEASE_MAGIC)
+            assert sk.recvint() == LEASE_MAGIC
+            sk.sendstr("w0")
+            sk.sendstr("gimme")
+        # the plane still serves honest clients
+        client = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "w0")
+        unit_id, _ = client.acquire()
+        assert unit_id == 0
+        assert client.commit(unit_id, {"rows": 1}) is True
+        assert coord.alive()
+    finally:
+        coord.stop()
+
+
+def test_malformed_commit_payload_rejected(corpus):
+    units = _units(corpus, num_workers=1, num_units=1)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=5.0,
+                                  sock_timeout=1.0)
+    coord.start()
+    try:
+        client = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "w0")
+        unit_id, _ = client.acquire()
+        with socket.create_connection(("127.0.0.1", coord.port)) as sock:
+            sk = FramedSocket(sock, timeout=2.0)
+            sk.sendint(LEASE_MAGIC)
+            assert sk.recvint() == LEASE_MAGIC
+            sk.sendstr("w0")
+            sk.sendstr("commit")
+            sk.sendint(unit_id)
+            sk.sendstr("not json")
+        # the rejected conversation didn't commit anything
+        assert coord.committed_total == 0
+        assert client.commit(unit_id, {"rows": 1}) is True
+    finally:
+        coord.stop()
+
+
+def test_worker_run_requires_port():
+    with pytest.raises(ValueError, match="port"):
+        fleet_ingest.run_worker("w0", "127.0.0.1", None)
+
+
+# -- cross-rank-consistent binner over disjoint unit sets ---------------------
+
+class _StubComm:
+    """Rabit-shaped allgather for in-process ranks (threads)."""
+
+    def __init__(self, world):
+        self.world = world
+        self._lock = threading.Lock()
+        self._slots = {}
+        self._barrier = threading.Barrier(world)
+
+    def rank_view(self, rank):
+        comm = self
+
+        class _View:
+            def allgather(self, value):
+                with comm._lock:
+                    comm._slots[rank] = np.asarray(value)
+                comm._barrier.wait()
+                out = np.stack([comm._slots[r]
+                                for r in sorted(comm._slots)])
+                comm._barrier.wait()  # slots safe to reuse after this
+                return out
+
+        return _View()
+
+
+def test_fleet_binner_bitwise_identical_across_workers(corpus):
+    """The PR 7 cross-rank-consistency claim, multi-worker for real: two
+    workers ingest DISJOINT unit sets (static residues), then fit one
+    binner through the fit_binner(comm=...) allgather merge — the edges
+    must be bitwise-identical on both ranks."""
+    units = _units(corpus, num_workers=2, num_units=6,
+                   dense_features=FEATURES)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, mode="static",
+                                  world_size=2, lease_timeout=5.0)
+    coord.start()
+    results = {}
+
+    def work(i):
+        results[i] = fleet_ingest.run_worker(
+            f"w{i}", "127.0.0.1", coord.port, worker_index=i,
+            lease_timeout=5.0, binner_bins=32)
+
+    try:
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        coord.result(timeout=10)
+    finally:
+        coord.stop()
+    # disjoint ingest, by construction of the static residues
+    assert set(results[0].unit_ids).isdisjoint(results[1].unit_ids)
+    assert results[0].summary_points is not None
+
+    comm = _StubComm(2)
+    binners = {}
+
+    def fit(i):
+        binners[i] = fleet_ingest.fleet_binner(results[i],
+                                               comm=comm.rank_view(i))
+
+    threads = [threading.Thread(target=fit, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    b0, b1 = binners[0], binners[1]
+    assert np.array_equal(b0.boundaries, b1.boundaries)
+    assert b0.boundaries.shape == (FEATURES, 31)
+    # and the shared edges bin identically on both "ranks"
+    probe = np.random.RandomState(1).randn(64, FEATURES).astype(np.float32)
+    assert np.array_equal(b0.transform(probe), b1.transform(probe))
+
+
+def test_fleet_binner_requires_summaries(corpus):
+    result = fleet_ingest.WorkerResult(worker_id="w0")
+    with pytest.raises(ValueError, match="binner_bins"):
+        fleet_ingest.fleet_binner(result)
+
+
+def test_fleet_binner_rejects_handle_missing():
+    """The fleet processor densifies absent features to 0.0; returning
+    missing-bin edges from those summaries would be silently skewed."""
+    result = fleet_ingest.WorkerResult(
+        worker_id="w0", binner_bins=8,
+        summary_points=np.zeros((1, 2, 64), np.float32),
+        summary_counts=np.ones((1, 2), np.float32))
+    with pytest.raises(ValueError, match="handle_missing"):
+        fleet_ingest.fleet_binner(result, handle_missing=True)
+
+
+@pytest.mark.chaos
+def test_rejected_unit_summaries_not_double_counted(corpus):
+    """Regression: a unit whose lease moved mid-processing is re-ingested
+    by the thief — the loser's commit is rejected AND its accumulated
+    binner summaries must be dropped, or that unit's rows enter the
+    fleet edges at double mass."""
+    units = _units(corpus, num_workers=1, num_units=1,
+                   dense_features=FEATURES)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=0.4)
+    coord.start()
+    # stall the loser's heartbeat so its lease expires mid-processing
+    fault.configure({"rules": [
+        {"site": "io.fleet.lease", "kind": "stall", "seconds": 1.0,
+         "times": None, "match": {"op": "renew", "worker": "loser"}}]})
+    stolen = threading.Event()
+    processing = threading.Event()
+
+    def slow_processor(spec, accum):
+        payload = fleet_ingest.default_unit_processor(spec, accum)
+        # summaries are accumulated; now lose the lease before committing
+        processing.set()
+        assert stolen.wait(timeout=30), "thief never took the lease"
+        return payload
+
+    try:
+        worker = {}
+        t = threading.Thread(target=lambda: worker.update(r=(
+            fleet_ingest.run_worker("loser", "127.0.0.1", coord.port,
+                                    lease_timeout=0.4, binner_bins=8,
+                                    processor=slow_processor))))
+        t.start()
+        # the thief only starts asking once the loser demonstrably holds
+        # the lease and has accumulated the unit's summaries
+        assert processing.wait(timeout=30)
+        thief = fleet_ingest.LeaseClient("127.0.0.1", coord.port, "thief")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            unit_id, _ = thief.acquire()
+            if unit_id == 0:
+                break
+            time.sleep(0.05)
+        assert unit_id == 0, "lease never expired onto the thief"
+        assert thief.commit(0, {"rows": ROWS}) is True
+        stolen.set()
+        t.join(timeout=30)
+        result = worker["r"]
+    finally:
+        fault.clear()
+        coord.stop()
+    assert result.units_rejected == 1 and result.units_committed == 0
+    assert result.rows == 0
+    # the rejected unit's summaries were dropped with its rows
+    assert result.summary_points is None
+
+
+# -- chaos: kill a worker mid-unit under the committed plan -------------------
+
+def _spawn_worker(worker_id, port, lease_timeout):
+    ctx = multiprocessing.get_context("spawn")
+    return ctx.Process(target=fleet_ingest.run_worker, args=(worker_id,),
+                       kwargs=dict(host="127.0.0.1", port=port,
+                                   lease_timeout=lease_timeout))
+
+
+@pytest.mark.chaos
+def test_chaos_killed_worker_exactly_once_coverage(corpus, monkeypatch):
+    """The committed benchmarks/fleet_fault_plan.json kills w1 at its
+    second commit — after processing, holding the lease.  The lease must
+    expire and be reassigned, survivors must finish the epoch, and the
+    ledger must reconcile EXACTLY against the ground-truth row ids (the
+    label-as-id corpus): zero lost rows, zero duplicated rows."""
+    units = _units(corpus, num_workers=3, num_units=9)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=1.0)
+    coord.start()
+    monkeypatch.setenv("DMLC_FAULT_PLAN", "@" + FLEET_PLAN)
+    procs = [_spawn_worker(f"w{i}", coord.port, 1.0) for i in range(3)]
+    try:
+        # w1 runs ALONE first so it deterministically reaches the second
+        # commit the committed plan kills it at (in a free-for-all, fast
+        # survivors could starve it below two units and the drill would
+        # silently not fire); it dies holding its in-flight lease, THEN
+        # the survivors start and must absorb the reassignment
+        procs[1].start()
+        procs[1].join(timeout=120)
+        procs[0].start()
+        procs[2].start()
+        procs[0].join(timeout=120)
+        procs[2].join(timeout=120)
+        ledger = coord.result(timeout=30)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        coord.stop()
+    # the injected exit demonstrably fired: w1 died with its exit code
+    assert procs[1].exitcode == 1, [p.exitcode for p in procs]
+    assert procs[0].exitcode == 0 and procs[2].exitcode == 0
+    # its in-flight lease moved at least once
+    assert coord.reassigned_total >= 1
+    assert "w1" in coord.failed_workers
+    # and coverage is exactly-once against ground truth
+    _check_exactly_once(ledger)
+    # the killed worker's committed units stay in the ledger (committed
+    # units are never re-run); only its in-flight unit moved
+    assert coord.committed_total == 9
+
+
+@pytest.mark.chaos
+def test_chaos_lease_client_survives_injected_reset(corpus):
+    """A reset fault on the lease wire is retried, not fatal, and fires
+    into the telemetry ledger."""
+    units = _units(corpus, num_workers=1, num_units=2)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=5.0)
+    coord.start()
+    fault.configure({"rules": [
+        {"site": "io.fleet.lease", "kind": "reset", "times": 1,
+         "match": {"op": "acquire"}}]})
+    try:
+        result = fleet_ingest.run_worker("w0", "127.0.0.1", coord.port,
+                                         lease_timeout=5.0)
+        assert result.rows == ROWS
+        assert ("io.fleet.lease", "reset", 0) in fault.fires()
+        coord.result(timeout=5)
+    finally:
+        fault.clear()
+        coord.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_straggler_sheds_load_to_healthy_workers(corpus):
+    """A delay fault on one worker's acquires makes dynamic leasing shift
+    units to the healthy worker — the work-stealing property the fleet-ab
+    straggler scenario measures."""
+    units = _units(corpus, num_workers=2, num_units=8)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=5.0)
+    coord.start()
+    fault.configure({"rules": [
+        {"site": "io.fleet.lease", "kind": "delay", "seconds": 0.25,
+         "times": None, "match": {"op": "acquire", "worker": "slow"}}]})
+    results = {}
+
+    def work(wid):
+        results[wid] = fleet_ingest.run_worker(wid, "127.0.0.1", coord.port,
+                                               lease_timeout=5.0)
+
+    try:
+        threads = [threading.Thread(target=work, args=(w,))
+                   for w in ("slow", "fast")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        ledger = coord.result(timeout=10)
+    finally:
+        fault.clear()
+        coord.stop()
+    _check_exactly_once(ledger)
+    # the healthy worker stole the bulk of the units
+    assert results["fast"].units_committed > results["slow"].units_committed
+
+
+@pytest.fixture
+def _clean_telemetry():
+    """Suite-safe telemetry toggle (the repo-wide fixture discipline: a
+    test must never leave the CI artifact flush disabled)."""
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    if was_enabled:
+        telemetry.enable()
+
+
+def test_fleet_metrics_and_spans_recorded(corpus, _clean_telemetry):
+    """The observability contract: assigned/committed counters and
+    ingest.lease / ingest.unit spans land in an enabled registry."""
+    telemetry.enable()
+    units = _units(corpus, num_workers=1, num_units=2)
+    coord = ShardLeaseCoordinator("127.0.0.1", units, lease_timeout=5.0)
+    coord.start()
+    try:
+        fleet_ingest.run_worker("w0", "127.0.0.1", coord.port,
+                                lease_timeout=5.0)
+        coord.result(timeout=10)
+    finally:
+        coord.stop()
+    snap = telemetry.snapshot()["metrics"]
+
+    def total(name):
+        fam = snap.get(name, {"samples": []})
+        return sum(s["value"] for s in fam["samples"])
+
+    assert total("dmlc_fleet_units_assigned_total") == 2
+    assert total("dmlc_fleet_units_committed_total") == 2
+    assert total("dmlc_fleet_worker_rows_total") == ROWS
+    assert total("dmlc_fleet_worker_busy_seconds_total") > 0
+    names = {e.get("name") for e in telemetry.get_tracer().events()}
+    assert {"ingest.fleet", "ingest.lease", "ingest.unit"} <= names
