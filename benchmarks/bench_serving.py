@@ -6,6 +6,10 @@
     python benchmarks/bench_serving.py knee [--out knee.json]
         [--qps 50,100,200] [--knobs 1:0.5,8:2,32:5] [--duration 3]
 
+    python benchmarks/bench_serving.py lifecycle [--out lifecycle.json]
+        [--fault-plan benchmarks/lifecycle_fault_plan.json | none]
+        [--swaps 3] [--qps 80] [--duration 5]
+
 ``smoke`` is the CI gate (docs/serving.md "SLO methodology"): it starts an
 in-process scoring server, drives open-loop traffic through an **active
 fault plan** (injected request stalls, a 503 storm, a queue stall, one
@@ -16,6 +20,16 @@ faults demonstrably fired.  The JSON report it writes is the artifact.
 ``knee`` sweeps offered load across 2-3 ``max_batch:max_delay_ms`` knob
 settings and records client-side latency quantiles per point — the
 latency/throughput knee curve committed under benchmarks/results/.
+
+``lifecycle`` is the hot-swap campaign gate (docs/serving.md "Model
+lifecycle"): a watched model slot serves open-loop traffic through a 503
+storm while a trainer thread publishes new checkpoint versions —
+including ONE whose validation is killed by the fault plan — and the run
+exits non-zero unless ``crashed == 0``, ``invalid == 0`` (every 200's
+predictions match the model version it names: no request ever saw a
+half-swapped model), at least ``--swaps - 1`` swaps completed, and
+previous-good kept serving across the rejected candidate.  The report
+carries a before/during-swaps latency table.
 """
 
 import argparse
@@ -28,6 +42,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 DEFAULT_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "serving_fault_plan.json")
+LIFECYCLE_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "lifecycle_fault_plan.json")
 NUM_FEATURE = 16
 
 
@@ -148,6 +164,160 @@ def run_knee(args) -> int:
     return 0
 
 
+def _bias_for(step: int) -> float:
+    """Per-version bias for the campaign's w=0 logistic model: every
+    prediction equals sigmoid(bias(step)), so the prediction value IS
+    the model version — the half-swapped-model detector."""
+    return -2.0 + 0.5 * step
+
+
+def run_lifecycle(args) -> int:
+    import math
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from dmlc_core_tpu import fault, telemetry
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+    from dmlc_core_tpu.serve import (CheckpointWatcher, ModelRegistry,
+                                     ScoringServer, build_runtime,
+                                     runtime_builder)
+    from dmlc_core_tpu.serve.loadgen import run_load
+
+    telemetry.enable()
+    plan_path = args.fault_plan
+    plan_active = plan_path.lower() != "none"
+    if plan_active:
+        with open(plan_path, encoding="utf-8") as f:
+            fault.configure(f.read())
+
+    ckpt_dir = tempfile.mkdtemp(prefix="lifecycle-ckpt-")
+    mgr = CheckpointManager(ckpt_dir, keep=args.swaps + 2)
+
+    def publish(step):
+        mgr.save(step, {"w": np.zeros(NUM_FEATURE, np.float32),
+                        "b": np.float32(_bias_for(step))}, async_=False)
+
+    def check(payload):
+        v = payload.get("version")
+        if not isinstance(v, int):
+            return False
+        want = 1.0 / (1.0 + math.exp(-_bias_for(v)))
+        return all(abs(p - want) < 1e-5 for p in payload["predictions"])
+
+    publish(1)
+    registry = ModelRegistry()
+    registry.add("champion",
+                 build_runtime("linear", NUM_FEATURE,
+                               checkpoint=mgr.step_uri(1)),
+                 version=1, max_batch=32, max_delay_ms=2.0, default=True)
+    last_step = 1 + args.swaps
+    report = {"fault_plan": plan_path if plan_active else None,
+              "host": _host_info(), "swaps_published": args.swaps,
+              "checkpoint_dir": ckpt_dir}
+    with ScoringServer(registry, request_timeout_s=8.0) as server:
+        watcher = CheckpointWatcher(registry, "champion", ckpt_dir,
+                                    runtime_builder("linear", NUM_FEATURE),
+                                    poll_s=0.25, manager=mgr)
+        with watcher:
+            # phase A: steady state, no swaps — the "before" latency
+            report["before"] = run_load(
+                server.url, qps=args.qps, duration_s=args.duration / 2,
+                num_feature=NUM_FEATURE, rows_per_request=2, seed=7,
+                timeout_s=8.0, model="champion", response_check=check)
+
+            # phase B: the trainer publishes a new version per
+            # swap-interval while the storm + load run — paced on the
+            # watcher's progress odometer (swaps + rejections), because
+            # the watcher is latest-wins: un-paced publishes would
+            # legitimately skip intermediate steps and the plan's
+            # validation kill could land on the final one
+            def trainer():
+                for step in range(2, last_step + 1):
+                    time.sleep(args.swap_interval)
+                    progress = (watcher.swaps_completed
+                                + watcher.rejections)
+                    publish(step)
+                    deadline = time.monotonic() + 30
+                    while (watcher.swaps_completed + watcher.rejections
+                           <= progress and time.monotonic() < deadline):
+                        time.sleep(0.05)
+
+            t = threading.Thread(target=trainer)
+            t.start()
+            report["during"] = run_load(
+                server.url, qps=args.qps, duration_s=args.duration,
+                num_feature=NUM_FEATURE, rows_per_request=2, seed=11,
+                timeout_s=8.0, model="champion", response_check=check)
+            t.join(30)
+            deadline = time.monotonic() + 15
+            while (watcher.swaps_completed < args.swaps - 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+            report["swaps_completed"] = watcher.swaps_completed
+            report["final_version"] = registry.get("champion").version
+    fired = [(site, kind) for site, kind, _ in fault.fires()]
+    report["faults_fired"] = sorted(set(fired))
+
+    failures = []
+    for phase in ("before", "during"):
+        c = report[phase]["counts"]
+        if c["crashed"] or c["error"]:
+            failures.append(f"{phase}: {c['crashed']} crashed + "
+                            f"{c['error']} unstructured errors")
+        if c["invalid"]:
+            failures.append(
+                f"{phase}: {c['invalid']} responses whose predictions do "
+                "not match the version that claims to have served them — "
+                "a half-swapped or mixed-version model answered")
+        if c["ok"] == 0:
+            failures.append(f"{phase}: no request succeeded")
+    # the plan kills exactly one validation: one candidate is rejected,
+    # every other published step must have swapped in
+    want_swaps = args.swaps - (1 if plan_active else 0)
+    if report["swaps_completed"] < max(2, want_swaps):
+        failures.append(
+            f"only {report['swaps_completed']} hot swaps completed "
+            f"(wanted >= {max(2, want_swaps)})")
+    if report["final_version"] != last_step:
+        failures.append(
+            f"final version {report['final_version']} != last published "
+            f"good step {last_step} — previous-good/recovery broke")
+    if plan_active:
+        if ("serve.swap", "error") not in fired:
+            failures.append("the validation-kill fault never fired")
+        if not any(s == "serve.request" for s, _ in fired):
+            failures.append("the 503 storm never fired")
+        shed = (report["before"]["counts"]["shed"]
+                + report["during"]["counts"]["shed"])
+        if shed == 0:
+            failures.append("storm active but nothing shed")
+    report["slo_ok"] = not failures
+    report["slo_failures"] = failures
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "checkpoint_dir"}, indent=1, sort_keys=True))
+    print("\nlifecycle campaign: "
+          f"{report['swaps_completed']} hot swaps, final version "
+          f"v{report['final_version']}")
+    print(f"{'phase':<8} {'ok':>5} {'shed':>5} {'invalid':>7} "
+          f"{'crashed':>7} {'p50ms':>8} {'p99ms':>8}")
+    for phase in ("before", "during"):
+        c = report[phase]["counts"]
+        lat = report[phase]["latency_ms"]
+        print(f"{phase:<8} {c['ok']:>5} {c['shed']:>5} {c['invalid']:>7} "
+              f"{c['crashed']:>7} {str(lat['p50']):>8} "
+              f"{str(lat['p99']):>8}")
+    for msg in failures:
+        print(f"LIFECYCLE FAILURE: {msg}")
+    return 0 if not failures else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -164,8 +334,24 @@ def main(argv=None) -> int:
                     help="comma list of max_batch:max_delay_ms settings")
     kn.add_argument("--duration", type=float, default=3.0)
     kn.add_argument("--rows", type=int, default=1)
+    lc = sub.add_parser("lifecycle",
+                        help="hot-swap campaign gate under a 503 storm")
+    lc.add_argument("--out", default=None)
+    lc.add_argument("--fault-plan", default=LIFECYCLE_PLAN,
+                    help="plan JSON path, or 'none' to disable injection")
+    lc.add_argument("--swaps", type=int, default=3,
+                    help="checkpoint versions published during the load "
+                         "(one validation is killed by the default plan)")
+    lc.add_argument("--qps", type=float, default=80.0)
+    lc.add_argument("--duration", type=float, default=5.0)
+    lc.add_argument("--swap-interval", type=float, default=1.2,
+                    help="seconds between published versions")
     args = p.parse_args(argv)
-    return run_smoke(args) if args.cmd == "smoke" else run_knee(args)
+    if args.cmd == "smoke":
+        return run_smoke(args)
+    if args.cmd == "lifecycle":
+        return run_lifecycle(args)
+    return run_knee(args)
 
 
 if __name__ == "__main__":

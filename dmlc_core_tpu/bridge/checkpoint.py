@@ -9,6 +9,16 @@ pod restores anywhere the URI resolves.
 
 Format: magic "DMLCTPU1" | u64 header_len | header JSON | leaf blobs in order.
 Header: {"leaves": [{"path": str, "dtype": str, "shape": [...]}, ...]}.
+
+**Manifests** (the serving hot-swap contract, docs/serving.md "Model
+lifecycle"): :class:`CheckpointManager` publishes a tiny JSON manifest
+beside each step — ``ckpt-XXXXXXXX.manifest.json`` with the step number,
+the blob's byte count, a CRC-32 over every blob byte, and the wall time —
+written only *after* the checkpoint bytes are durable.  A reader that goes
+manifest-first therefore never opens a partially written checkpoint on a
+store without atomic rename, and :func:`verify_checkpoint` re-hashes the
+blob against its manifest so corrupt/truncated bytes are rejected before
+any jax work touches them.
 """
 
 from __future__ import annotations
@@ -17,8 +27,11 @@ import glob
 import json
 import os
 import re
+import struct
 import threading
-from typing import Any, List, Optional
+import time
+import zlib
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -26,9 +39,20 @@ from dmlc_core_tpu.io.stream import create_stream, create_stream_for_read
 from dmlc_core_tpu.utils.logging import CHECK, CHECK_EQ, log_info, log_warning
 
 __all__ = ["save_checkpoint", "load_checkpoint", "AsyncCheckpointer",
-           "CheckpointManager"]
+           "CheckpointManager", "CheckpointCorruptError", "verify_checkpoint"]
 
 _MAGIC = b"DMLCTPU1"
+
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_VERSION = 1
+
+_VERIFY_CHUNK = 1 << 20
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint whose bytes disagree with its manifest (or that is not
+    a checkpoint at all) — the one error a hot-swap validator must turn
+    into "previous-good keeps serving", never into a crash."""
 
 
 def _flatten(tree: Any):
@@ -91,8 +115,10 @@ def _sweep_orphan_temps(base_path: str) -> None:
             pass
 
 
-def save_checkpoint(uri: str, tree: Any) -> None:
-    """Write a pytree of arrays/scalars to ``uri``.
+def save_checkpoint(uri: str, tree: Any) -> Dict[str, Any]:
+    """Write a pytree of arrays/scalars to ``uri``; returns a digest summary
+    ``{"nbytes", "crc32", "num_leaves"}`` over the exact bytes written (what
+    :class:`CheckpointManager` publishes as the step's manifest).
 
     Local writes are atomic (temp file + rename), so a crash mid-write never
     leaves a truncated checkpoint at the final path.  Remote stores already
@@ -113,14 +139,60 @@ def save_checkpoint(uri: str, tree: Any) -> None:
         # across hosts on a shared filesystem) must not interleave writes
         # into one temp file and rename a torn mix
         target = f"{uri}.tmp.{_temp_suffix()}"
+    crc = 0
+    nbytes = 0
+
+    def _put(fo, chunk: bytes) -> None:
+        nonlocal crc, nbytes
+        fo.write(chunk)
+        crc = zlib.crc32(chunk, crc)
+        nbytes += len(chunk)
+
     with create_stream(target, "w") as fo:
-        fo.write(_MAGIC)
+        _put(fo, _MAGIC)
         fo.write_u64(len(header))
-        fo.write(header)
+        crc = zlib.crc32(struct.pack("<Q", len(header)), crc)
+        nbytes += 8
+        _put(fo, header)
         for a in arrays:
-            fo.write(np.ascontiguousarray(a).tobytes())
+            _put(fo, np.ascontiguousarray(a).tobytes())
     if local:
         os.replace(_strip_file_scheme(target), _strip_file_scheme(uri))
+    return {"nbytes": nbytes, "crc32": crc, "num_leaves": len(arrays)}
+
+
+def verify_checkpoint(uri: str, manifest: Dict[str, Any]) -> None:
+    """Re-hash the blob at ``uri`` against its manifest — magic, byte
+    count, CRC-32 — raising :class:`CheckpointCorruptError` on any
+    disagreement.  Pure byte IO: no numpy reshaping, no jax, so a hot-swap
+    validator can reject a torn or bit-rotted candidate before any model
+    work starts.
+    """
+    want_nbytes = int(manifest.get("nbytes", -1))
+    want_crc = int(manifest.get("crc32", -1))
+    crc = 0
+    nbytes = 0
+    first = b""
+    with (create_stream_for_read(uri) or create_stream(uri, "r")) as fi:
+        while True:
+            chunk = fi.read(_VERIFY_CHUNK)
+            if not chunk:
+                break
+            if nbytes < len(_MAGIC):
+                first += chunk[:len(_MAGIC) - nbytes]
+            crc = zlib.crc32(chunk, crc)
+            nbytes += len(chunk)
+    if first != _MAGIC:
+        raise CheckpointCorruptError(
+            f"{uri!r}: not a dmlc_core_tpu checkpoint (bad magic)")
+    if nbytes != want_nbytes:
+        raise CheckpointCorruptError(
+            f"{uri!r}: {nbytes} bytes on store, manifest says "
+            f"{want_nbytes} (truncated or torn write)")
+    if crc != want_crc:
+        raise CheckpointCorruptError(
+            f"{uri!r}: CRC-32 mismatch (got {crc:#010x}, manifest says "
+            f"{want_crc:#010x}) — corrupt checkpoint")
 
 
 def load_checkpoint(uri: str, template: Any = None) -> Any:
@@ -176,8 +248,10 @@ class AsyncCheckpointer:
         """Snapshot ``tree`` and write it in the background.
 
         ``on_durable`` (optional) runs on the writer thread only after the
-        checkpoint bytes are fully committed — the hook retention uses so
-        older steps are never deleted while the new one is still in flight.
+        checkpoint bytes are fully committed, receiving the
+        :func:`save_checkpoint` digest summary — the hook manifest
+        publication and retention use, so older steps are never deleted
+        (and the manifest never appears) while the write is in flight.
         """
         self.wait_until_finished()
         # snapshot on the caller's thread: device->host transfer completes
@@ -186,14 +260,14 @@ class AsyncCheckpointer:
 
         def _write():
             try:
-                save_checkpoint(uri, snapshot)
+                summary = save_checkpoint(uri, snapshot)
             except BaseException as e:  # ferried to the caller's thread
                 self._error = e
                 self._error_uri = uri
                 return
             if on_durable is not None:
                 try:
-                    on_durable()
+                    on_durable(summary)
                 except BaseException as e:
                     # the checkpoint IS durable — a retention/hook failure
                     # must not masquerade as a write failure and block restore
@@ -247,8 +321,14 @@ class CheckpointManager:
         self._is_local = "://" not in directory or \
             directory.startswith("file://")
 
-    def _step_uri(self, step: int) -> str:
+    def step_uri(self, step: int) -> str:
         return f"{self.directory}/ckpt-{step:08d}"
+
+    # internal alias kept for call-site brevity
+    _step_uri = step_uri
+
+    def manifest_uri(self, step: int) -> str:
+        return self.step_uri(step) + MANIFEST_SUFFIX
 
     def all_steps(self) -> List[int]:
         from dmlc_core_tpu.io.filesys import URI, get_filesystem
@@ -281,15 +361,77 @@ class CheckpointManager:
             # live writers' temps are skipped
             _sweep_orphan_temps(_strip_file_scheme(uri))
         if async_:
-            # retention runs on the writer thread only once the new step is
-            # durable — deleting older steps before that could leave zero
-            # restorable checkpoints if the in-flight write fails (keep=1)
+            # manifest + retention run on the writer thread only once the
+            # new step is durable — publishing the manifest earlier would
+            # point readers at in-flight bytes, and deleting older steps
+            # before durability could leave zero restorable checkpoints
             self._async.save(uri, tree,
-                             on_durable=lambda: self._retain(step))
+                             on_durable=lambda summary:
+                             self._publish(step, summary))
         else:
-            save_checkpoint(uri, tree)
-            self._retain(step)
+            summary = save_checkpoint(uri, tree)
+            self._publish(step, summary)
         log_info(f"checkpoint step {step} -> {uri}")
+
+    def _publish(self, step: int, summary: Dict[str, Any]) -> None:
+        """Write the step's manifest (the durable blob's digest), then run
+        retention.  Ordering is the whole point: a manifest-first reader
+        (the serving checkpoint watcher) never opens a checkpoint whose
+        bytes are still in flight."""
+        self.write_manifest(step, summary)
+        self._retain(step)
+
+    def write_manifest(self, step: int, summary: Dict[str, Any]) -> None:
+        manifest = {
+            "manifest_version": MANIFEST_VERSION,
+            "step": int(step),
+            "nbytes": int(summary["nbytes"]),
+            "crc32": int(summary["crc32"]),
+            "num_leaves": int(summary.get("num_leaves", 0)),
+            # current wall time, NOT clock.wall_epoch() (that is the
+            # constant process-start anchor — every manifest a long
+            # trainer publishes would carry the same timestamp)
+            "written_at": time.time(),
+        }
+        uri = self.manifest_uri(step)
+        payload = json.dumps(manifest, sort_keys=True).encode("utf-8")
+        target = uri
+        if self._is_local:
+            # same atomic temp+rename discipline as the blob: a torn
+            # manifest must never validate (or invalidate) a good blob
+            target = f"{uri}.tmp.{_temp_suffix()}"
+        with create_stream(target, "w") as fo:
+            fo.write(payload)
+        if self._is_local:
+            os.replace(_strip_file_scheme(target), _strip_file_scheme(uri))
+
+    def read_manifest(self, step: int) -> Optional[Dict[str, Any]]:
+        """The step's manifest dict, or ``None`` when it is absent or
+        unparseable — both mean "do not trust this checkpoint yet" to a
+        manifest-first reader (absent = the blob may still be writing)."""
+        uri = self.manifest_uri(step)
+        try:
+            with (create_stream_for_read(uri) or create_stream(uri, "r")) as fi:
+                chunks = []
+                while True:
+                    chunk = fi.read(1 << 16)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                raw = b"".join(chunks)
+        except Exception:
+            return None
+        try:
+            manifest = json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            log_warning(f"checkpoint manifest {uri!r} unparseable ({e}); "
+                        "treating the step as unpublished")
+            return None
+        if not isinstance(manifest, dict):
+            log_warning(f"checkpoint manifest {uri!r} is not an object; "
+                        "treating the step as unpublished")
+            return None
+        return manifest
 
     def restore(self, step: Optional[int] = None,
                 template: Any = None) -> Any:
@@ -331,6 +473,12 @@ class CheckpointManager:
         excess = [s for s in steps[:-self.keep] if s != current_step]
         for s in excess:
             path = _strip_file_scheme(self._step_uri(s))
+            # manifest first: a step must never look published (manifest
+            # present) after its blob is gone
+            try:
+                os.remove(path + MANIFEST_SUFFIX)
+            except OSError:
+                pass
             try:
                 os.remove(path)
             except OSError:

@@ -26,7 +26,10 @@ Injection sites (see :data:`SITES`):
   (``exit`` with ``match {"op": "commit"}`` = kill a worker mid-unit,
   after processing but before its commit lands — the reassignment drill);
 - ``serve.request`` / ``serve.queue`` / ``serve.predict`` — the scoring
-  service's ingress, batch assembly, and model call (docs/serving.md).
+  service's ingress, batch assembly, and model call (docs/serving.md);
+- ``serve.swap``           — the model-lifecycle watcher's
+  watch/validate/warmup/swap stages (hot-swap chaos: a rejected candidate
+  must leave previous-good serving).
 
 **Disabled is the default and costs one attribute load + branch**: every
 helper returns immediately while no plan is configured, and the instrumented
@@ -119,6 +122,13 @@ SITES: Dict[str, str] = {
         "model=<family>, rows=<n>); 'error' models a killed predict "
         "worker — that batch's requests fail with a structured 503 "
         "predict_failed and the batcher continues"),
+    "serve.swap": (
+        "model-lifecycle watcher, once per stage of each hot-swap cycle "
+        "(ctx: model=<slot>, stage=watch|validate|warmup|swap); "
+        "'error'/'reset' during validate or warmup reject the candidate "
+        "— previous-good keeps serving; 'stall' during swap delays the "
+        "pointer flip but can never tear it (docs/serving.md \"Model "
+        "lifecycle\")"),
 }
 
 _plan: Optional[FaultPlan] = None

@@ -1283,6 +1283,13 @@ class GBDT:
         """
         from dmlc_core_tpu.bridge.checkpoint import save_checkpoint
 
+        save_checkpoint(uri, self._model_payload(ensemble, extra))
+
+    def _model_payload(self, ensemble: TreeEnsemble,
+                       extra: Optional[dict] = None) -> dict:
+        """The checkpoint pytree ``save_model`` writes (trees + binning
+        boundaries + routing contract), as a dict — the single schema both
+        the URI writer and :meth:`serving_state` build from."""
         CHECK(self.boundaries is not None, "model has no bin boundaries")
         payload = {
             "split_feat": np.asarray(ensemble.split_feat),
@@ -1315,7 +1322,7 @@ class GBDT:
                   f"extra key {k!r} has object dtype; convert to a "
                   f"numeric or fixed-width string array first")
             payload[k] = arr
-        save_checkpoint(uri, payload)
+        return payload
 
     def load_model(self, uri: str) -> TreeEnsemble:
         from dmlc_core_tpu.bridge.checkpoint import load_checkpoint
@@ -1368,6 +1375,65 @@ class GBDT:
         return TreeEnsemble(sf, get("split_bin"), get("leaf_value"), dl,
                             None if sg is None else np.asarray(sg),
                             None if sc is None else np.asarray(sc))
+
+    def serving_state(self, ensemble: TreeEnsemble) -> dict:
+        """Self-describing checkpoint pytree for the model-lifecycle path
+        (docs/serving.md): the :meth:`save_model` payload plus a
+        ``serve_meta`` leaf recording everything a loader needs to rebuild
+        this GBDT *without* knowing its params up front — num_feature,
+        num_bins, max_depth, objective, num_class.  The binner edges
+        (``set_boundaries`` contract) ride the same blob, so a swapped-in
+        model always serves through the exact bins it trained on.
+
+        Feed this to :class:`~dmlc_core_tpu.bridge.checkpoint.
+        CheckpointManager`.save and restore with :meth:`from_serving_state`.
+        """
+        return self._model_payload(ensemble, extra={
+            _SERVE_META_KEY: np.array(
+                [_SERVE_SCHEMA, self.num_feature, self.param.num_bins,
+                 self.param.max_depth,
+                 _OBJECTIVE_CODES[self.param.objective],
+                 self.param.num_class],
+                np.int64)})
+
+    @classmethod
+    def from_serving_state(cls, flat: dict) -> Tuple["GBDT", TreeEnsemble]:
+        """Rebuild (GBDT, ensemble) from a flat :func:`~dmlc_core_tpu.
+        bridge.checkpoint.load_checkpoint` dict written by
+        :meth:`serving_state` — boundaries installed, predictions
+        bitwise-equal to the saver's (round-trip asserted in
+        tests/test_lifecycle.py)."""
+        meta = flat.get(f"['{_SERVE_META_KEY}']")
+        CHECK(meta is not None,
+              "checkpoint has no serve_meta leaf — not a serving_state "
+              "blob (train-side save_model checkpoints need their "
+              "GBDTParam known to the loader)")
+        meta = np.asarray(meta).reshape(-1)
+        CHECK(meta.shape[0] == 6 and int(meta[0]) == _SERVE_SCHEMA,
+              f"unsupported serve_meta schema {meta!r}")
+        _, num_feature, num_bins, max_depth, obj_code, num_class = (
+            int(v) for v in meta)
+        CHECK(obj_code in _OBJECTIVE_FROM_CODE,
+              f"serve_meta names unknown objective code {obj_code}")
+        hm = flat.get("['handle_missing']")
+        bs = flat.get("['base_score']")
+        split_feat = flat.get("['split_feat']")
+        CHECK(split_feat is not None, "checkpoint is missing split_feat")
+        param = GBDTParam(
+            objective=_OBJECTIVE_FROM_CODE[obj_code],
+            num_bins=num_bins, max_depth=max_depth, num_class=num_class,
+            num_boost_round=max(1, int(np.asarray(split_feat).shape[0])),
+            handle_missing=bool(hm[0]) if hm is not None else False,
+            base_score=float(bs[0]) if bs is not None else 0.0)
+        gbdt = cls(param, num_feature)
+        return gbdt, gbdt.load_model_dict(flat)
+
+
+# serving_state schema: bump when the serve_meta layout changes
+_SERVE_SCHEMA = 1
+_SERVE_META_KEY = "serve_meta"
+_OBJECTIVE_CODES = {"logistic": 0, "squared": 1, "softmax": 2}
+_OBJECTIVE_FROM_CODE = {v: k for k, v in _OBJECTIVE_CODES.items()}
 
 
 class _EarlyStop:

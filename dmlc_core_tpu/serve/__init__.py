@@ -19,7 +19,14 @@ subsystems earlier PRs shipped:
 - **proof** (:mod:`.loadgen`, ``benchmarks/bench_serving.py``) — an
   open-loop load harness that drives fault plans through the service and
   emits a JSON SLO report; the CI ``serve`` job fails unless every
-  request under an active fault plan completes or sheds structurally.
+  request under an active fault plan completes or sheds structurally;
+- **model lifecycle** (:mod:`.registry`, :mod:`.lifecycle`) — named model
+  slots with per-model admission budgets behind ``/v1/score/<model>``
+  routing, and a checkpoint watcher that validates each new training
+  checkpoint off-path (manifest-first, CRC-checked, bucket-ladder
+  pre-warmed) and hot-swaps it behind the scheduler with zero dropped
+  requests — the closed train→serve loop, scoring through the same uint8
+  binned wire + ``HostBinner`` edges the model trained on.
 
 See docs/serving.md for the architecture, the knee-curve methodology, and
 every knob.
@@ -28,9 +35,12 @@ every knob.
 from dmlc_core_tpu.serve.admission import AdmissionController  # noqa: F401
 from dmlc_core_tpu.serve.errors import (BadRequest, Overloaded,  # noqa: F401
                                         PredictFailed, RequestTimeout,
-                                        ServeError)
+                                        ServeError, UnknownModel)
+from dmlc_core_tpu.serve.lifecycle import (CheckpointWatcher,  # noqa: F401
+                                           runtime_builder)
 from dmlc_core_tpu.serve.model_runtime import (GBDTRuntime,  # noqa: F401
                                                LinearRuntime, MLPRuntime,
                                                ModelRuntime, build_runtime)
+from dmlc_core_tpu.serve.registry import ModelRegistry, ModelSlot  # noqa: F401
 from dmlc_core_tpu.serve.scheduler import MicroBatcher, batch_buckets  # noqa: F401
 from dmlc_core_tpu.serve.server import ScoringServer  # noqa: F401

@@ -24,6 +24,7 @@ from typing import List, Optional
 
 from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.serve.model_runtime import build_runtime
+from dmlc_core_tpu.serve.registry import ModelRegistry
 from dmlc_core_tpu.serve.server import ScoringServer
 
 
@@ -54,6 +55,17 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-warmup", action="store_true",
                    help="skip compile-ahead warmup (first requests of each "
                         "batch shape will pay XLA compilation)")
+    p.add_argument("--model-name", default=None,
+                   help="slot name for routing/metrics (default: the "
+                        "model family)")
+    p.add_argument("--watch-dir", default=None,
+                   help="CheckpointManager directory URI to watch: new "
+                        "steps are validated off-path and hot-swapped in "
+                        "with zero downtime (docs/serving.md \"Model "
+                        "lifecycle\")")
+    p.add_argument("--watch-interval-s", type=float, default=None,
+                   help="watcher poll interval (default: "
+                        "DMLC_SERVE_WATCH_S or 2.0)")
     return p
 
 
@@ -69,10 +81,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     telemetry.enable()
     runtime = build_runtime(args.model, args.num_feature, seed=args.seed,
                             checkpoint=args.checkpoint)
+    name = args.model_name or runtime.name
+    registry = ModelRegistry()
+    registry.add(name, runtime, max_batch=args.max_batch,
+                 max_delay_ms=args.max_delay_ms,
+                 max_queue_bytes=args.max_queue_bytes, default=True)
     server = ScoringServer(
-        runtime, host=args.host, port=args.port, max_batch=args.max_batch,
-        max_delay_ms=args.max_delay_ms, max_queue_bytes=args.max_queue_bytes,
+        registry, host=args.host, port=args.port,
         request_timeout_s=args.request_timeout_s, warmup=not args.no_warmup)
+    watcher = None
+    if args.watch_dir:
+        from dmlc_core_tpu.serve.lifecycle import (CheckpointWatcher,
+                                                   runtime_builder)
+
+        watcher = CheckpointWatcher(
+            registry, name, args.watch_dir,
+            runtime_builder(args.model, args.num_feature, seed=args.seed),
+            poll_s=args.watch_interval_s)
     stop = threading.Event()
 
     def _signal(signum, frame):  # noqa: ARG001 (signal contract)
@@ -81,9 +106,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGINT, _signal)
     signal.signal(signal.SIGTERM, _signal)
     with server:
-        print(f"serving {runtime.name} on {server.url} "
-              f"(ctrl-c to stop)")
-        stop.wait()
+        if watcher is not None:
+            watcher.start()
+        try:
+            # keep "serving <name> on <url>" as the stable prefix: headless
+            # launchers (tests/test_trace_e2e.py) scrape this line for the
+            # bound URL
+            print(f"serving {name} on {server.url} "
+                  f"(model={runtime.name}, ctrl-c to stop)")
+            stop.wait()
+        finally:
+            if watcher is not None:
+                watcher.close()
     print("serve: shut down cleanly")
     return 0
 

@@ -59,12 +59,20 @@ def queue_bytes_from_env() -> int:
 
 
 class AdmissionController:
-    """Byte-reservation gate in front of the micro-batch queue."""
+    """Byte-reservation gate in front of the micro-batch queue.
 
-    def __init__(self, max_queue_bytes: int = DEFAULT_QUEUE_BYTES):
+    Every model slot owns its own controller (``name`` labels the metrics)
+    with its own byte budget — the per-model admission discipline of
+    docs/serving.md "Model lifecycle": one model's burst sheds *that
+    model's* traffic, never a co-hosted neighbour's.
+    """
+
+    def __init__(self, max_queue_bytes: int = DEFAULT_QUEUE_BYTES,
+                 name: str = "default"):
         if max_queue_bytes <= 0:
             raise ValueError(
                 f"max_queue_bytes must be > 0, got {max_queue_bytes}")
+        self.name = name
         self.max_queue_bytes = int(max_queue_bytes)
         self._lock = threading.Lock()
         self._queued = 0
@@ -86,7 +94,8 @@ class AdmissionController:
         """
         nbytes = int(nbytes)
         if nbytes > self.max_queue_bytes:
-            telemetry.count("dmlc_serve_shed_total", reason="oversized")
+            telemetry.count("dmlc_serve_shed_total", model=self.name,
+                            reason="oversized")
             raise BadRequest(
                 f"request payload ({nbytes} bytes) exceeds the server's "
                 f"whole queue bound ({self.max_queue_bytes}); split it",
@@ -98,9 +107,11 @@ class AdmissionController:
                 queued = self._queued
             else:
                 self._queued += nbytes
-                telemetry.gauge_set("dmlc_serve_queue_bytes", self._queued)
+                telemetry.gauge_set("dmlc_serve_queue_bytes", self._queued,
+                                    model=self.name)
                 return
-        telemetry.count("dmlc_serve_shed_total", reason="queue_bytes")
+        telemetry.count("dmlc_serve_shed_total", model=self.name,
+                        reason="queue_bytes")
         raise Overloaded(
             f"scoring queue full ({queued}/{self.max_queue_bytes} bytes "
             f"reserved); retry after {retry:.0f}s",
@@ -121,7 +132,8 @@ class AdmissionController:
         now = clock.monotonic()
         with self._lock:
             self._queued = max(0, self._queued - nbytes)
-            telemetry.gauge_set("dmlc_serve_queue_bytes", self._queued)
+            telemetry.gauge_set("dmlc_serve_queue_bytes", self._queued,
+                                model=self.name)
             if self._window_start is None:
                 self._window_start = now
                 self._window_bytes = nbytes

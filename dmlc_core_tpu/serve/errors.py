@@ -21,7 +21,7 @@ import json
 from typing import Any, Dict, Optional
 
 __all__ = ["ServeError", "BadRequest", "Overloaded", "PredictFailed",
-           "RequestTimeout"]
+           "RequestTimeout", "UnknownModel"]
 
 
 class ServeError(Exception):
@@ -94,3 +94,12 @@ class RequestTimeout(ServeError):
 
     status = 504
     code = "timeout"
+
+
+class UnknownModel(ServeError):
+    """``/v1/score/<model>`` named a model no slot serves (404 — the
+    details list what IS registered, so a mis-deployed client can see
+    its routing bug without server logs)."""
+
+    status = 404
+    code = "unknown_model"

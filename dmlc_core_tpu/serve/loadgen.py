@@ -18,6 +18,10 @@ Outcome taxonomy (the SLO vocabulary of docs/serving.md):
 ``error``      any other structured 5xx
 ``crashed``    no structured answer at all: connection refused/reset,
                truncated body, unparseable response
+``invalid``    200 whose body fails the caller's ``response_check`` —
+               the answer arrived but is WRONG (the hot-swap drill uses
+               this to catch a response whose predictions do not match
+               the model version it claims served them)
 =============  ==============================================================
 
 The graceful-degradation proof is ``crashed == 0`` under an active fault
@@ -49,7 +53,8 @@ __all__ = ["run_load", "percentile", "LoadReport"]
 # how many worst-latency samples the report names by trace id
 SLOWEST_TRACES = 5
 
-OUTCOMES = ("ok", "shed", "timeout", "rejected", "error", "crashed")
+OUTCOMES = ("ok", "shed", "timeout", "rejected", "error", "crashed",
+            "invalid")
 
 LoadReport = Dict[str, Any]
 
@@ -106,11 +111,12 @@ class _Recorder:
                 for lat, t, outcome, status in worst]
 
 
-def _issue(url: str, body: bytes, timeout_s: float,
-           expect_rows: int, traceparent: str) -> tuple:
+def _issue(url: str, path: str, body: bytes, timeout_s: float,
+           expect_rows: int, traceparent: str,
+           response_check=None) -> tuple:
     """One POST; returns (outcome, status|None)."""
     req = urllib.request.Request(
-        url + "/v1/score", data=body,
+        url + path, data=body,
         headers={"Content-Type": "application/json",
                  "traceparent": traceparent}, method="POST")
     try:
@@ -118,6 +124,13 @@ def _issue(url: str, body: bytes, timeout_s: float,
             payload = json.load(resp)
             preds = payload.get("predictions")
             if isinstance(preds, list) and len(preds) == expect_rows:
+                if response_check is not None \
+                        and not response_check(payload):
+                    # a well-formed 200 that is WRONG (e.g. predictions
+                    # inconsistent with the version it claims): worse
+                    # than a shed, and the one outcome a half-swapped
+                    # model could produce
+                    return "invalid", resp.status
                 return "ok", resp.status
             return "crashed", resp.status  # 200 with a wrong-shaped body
     except urllib.error.HTTPError as e:
@@ -152,10 +165,18 @@ def _issue(url: str, body: bytes, timeout_s: float,
 
 def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
              rows_per_request: int = 1, seed: int = 0,
-             timeout_s: float = 10.0, max_workers: int = 64) -> LoadReport:
+             timeout_s: float = 10.0, max_workers: int = 64,
+             model: Optional[str] = None,
+             response_check=None) -> LoadReport:
     """Drive open-loop traffic at ``qps`` for ``duration_s``; returns the
-    SLO report dict (see module docstring for the outcome taxonomy)."""
+    SLO report dict (see module docstring for the outcome taxonomy).
+
+    ``model`` routes every request to ``/v1/score/<model>`` (multi-model
+    serving); ``response_check(payload) -> bool`` classifies a well-formed
+    200 whose body is semantically wrong as ``invalid``."""
     from concurrent.futures import ThreadPoolExecutor
+
+    path = "/v1/score" if model is None else f"/v1/score/{model}"
 
     rng = random.Random(seed)
     # Poisson arrival offsets within [0, duration)
@@ -184,7 +205,8 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         tp = tracecontext.format_traceparent(
             tracecontext.TraceContext(trace_id, span_id))
         t0 = clock.monotonic()
-        outcome, status = _issue(url, body, timeout_s, rows_per_request, tp)
+        outcome, status = _issue(url, path, body, timeout_s,
+                                 rows_per_request, tp, response_check)
         t1 = clock.monotonic()
         telemetry.record_span("client.request", t0, t1,
                               trace=(trace_id, span_id, None),
@@ -214,8 +236,9 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         "statuses": dict(sorted(rec.statuses.items())),
         "achieved_qps": round(rec.counts["ok"] / wall, 2) if wall else 0.0,
         "shed_rate": round(rec.counts["shed"] / n, 4) if n else 0.0,
-        "error_rate": round((rec.counts["error"] + rec.counts["crashed"])
-                            / n, 4) if n else 0.0,
+        "error_rate": round((rec.counts["error"] + rec.counts["crashed"]
+                             + rec.counts["invalid"]) / n, 4) if n else 0.0,
+        "model": model,
         "latency_ms": {
             "p50": _ms(percentile(lat_ok, 0.50)),
             "p95": _ms(percentile(lat_ok, 0.95)),

@@ -45,6 +45,9 @@ class ModelRuntime:
     def __init__(self, num_feature: int):
         CHECK(num_feature >= 1, "num_feature must be >= 1")
         self.num_feature = int(num_feature)
+        #: checkpoint step this runtime was built from; stamped by the
+        #: model registry before the runtime can serve (None = unmanaged)
+        self.version: Optional[int] = None
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         """``[B, F] float32 -> [B]`` scores or ``[B, K]`` probabilities.
@@ -52,9 +55,27 @@ class ModelRuntime:
         ``B`` is a padded bucket size; padding rows produce garbage scores
         the scheduler slices off — runtimes must tolerate all-zero rows.
         Returns a **host** ndarray (the device sync happens here, inside
-        the scheduler's predict span).
+        the scheduler's predict span).  Subclasses override exactly one of
+        ``predict`` (numpy stubs) or ``predict_async`` (jax runtimes).
         """
-        raise NotImplementedError
+        if type(self).predict_async is ModelRuntime.predict_async:
+            raise NotImplementedError(
+                "runtimes must override predict or predict_async")
+        return np.asarray(self.predict_async(x))
+
+    def predict_async(self, x: np.ndarray):
+        """Dispatch predict without waiting for the result.
+
+        jax-backed runtimes override this to return the **un-synced**
+        device array the jit call handed back — the transfer and compute
+        are already queued on the device, and ``np.asarray`` on the handle
+        is the sync point.  The scheduler's double-buffered loop dispatches
+        batch N+1 (host binning + device transfer + compute, all queued
+        behind N) before syncing batch N, so the wire transfer hides behind
+        the previous predict.  The base implementation is the sync fallback
+        for plain numpy runtimes that override ``predict``.
+        """
+        return self.predict(x)
 
     def warmup(self, batch_sizes: Sequence[int]) -> int:
         """Compile predict for each batch bucket; returns shapes warmed.
@@ -115,8 +136,8 @@ class LinearRuntime(ModelRuntime):
             self._jit = jax.jit(predict)
         return self._jit
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        return np.asarray(self._fn()(self.params, x))
+    def predict_async(self, x: np.ndarray):
+        return self._fn()(self.params, x)
 
 
 class MLPRuntime(ModelRuntime):
@@ -144,23 +165,51 @@ class MLPRuntime(ModelRuntime):
             self._jit = jax.jit(predict)
         return self._jit
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        return np.asarray(self._fn()(self.params, x))
+    def predict_async(self, x: np.ndarray):
+        return self._fn()(self.params, x)
 
 
 class GBDTRuntime(ModelRuntime):
-    """Serving facade over a trained TreeEnsemble + binning boundaries."""
+    """Serving facade over a trained TreeEnsemble + binning boundaries.
+
+    Scoring goes through the **binned device feed** (ROADMAP train→serve
+    item): features are quantized on the host by a
+    :class:`~dmlc_core_tpu.bridge.binning.HostBinner` built from the
+    model's own ``boundaries`` — the numpy ``searchsorted(side="right")``
+    twin of the training-time :func:`~dmlc_core_tpu.ops.histogram.
+    apply_bins` — and the wire ships the narrow uint8/uint16 ids, widened
+    back to int32 inside the jit.  Serving therefore applies *the exact
+    binning the model trained on*: bin ids (and so every split decision)
+    are bitwise-equal to the float path by construction, asserted against
+    :meth:`predict_float` and against ``apply_bins`` in
+    tests/test_serve.py + tests/test_device_feed.py.
+    """
 
     name = "gbdt"
 
     def __init__(self, gbdt, ensemble):
+        from dmlc_core_tpu.bridge.binning import HostBinner
+
         CHECK(gbdt.boundaries is not None,
               "GBDTRuntime needs fitted binning boundaries (make_bins)")
         super().__init__(gbdt.num_feature)
         self.gbdt = gbdt
         self.ensemble = ensemble
+        # the slot's binner edges: the train/serve-skew-free contract
+        self.binner = HostBinner(np.asarray(gbdt.boundaries),
+                                 gbdt.param.num_bins,
+                                 handle_missing=gbdt.param.handle_missing)
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
+    def predict_async(self, x: np.ndarray):
+        # host binning -> narrow wire -> async device dispatch: the uint8
+        # transfer for this batch queues behind the previous batch's
+        # compute (the scheduler syncs that one only after this dispatch)
+        bins = self.binner.transform(x)
+        return self.gbdt.predict(self.ensemble, bins)
+
+    def predict_float(self, x: np.ndarray) -> np.ndarray:
+        """The training-time float path (device-side ``apply_bins``), kept
+        as the reference the skew-free contract tests compare against."""
         bins = self.gbdt.bin_features(x)
         return np.asarray(self.gbdt.predict(self.ensemble, bins))
 
@@ -202,9 +251,17 @@ def build_runtime(kind: str, num_feature: int, *, seed: int = 0,
     if kind == "gbdt":
         from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
 
-        CHECK(checkpoint is None,
-              "gbdt checkpoint loading is not wired yet; build the runtime "
-              "from a fitted GBDT + ensemble directly")
+        if checkpoint:
+            from dmlc_core_tpu.bridge.checkpoint import load_checkpoint
+
+            # serving_state blobs are self-describing (trees + binner
+            # edges + serve_meta in one pytree): no template needed
+            gbdt, ensemble = GBDT.from_serving_state(
+                load_checkpoint(checkpoint))
+            CHECK(gbdt.num_feature == num_feature,
+                  f"checkpoint {checkpoint!r} serves {gbdt.num_feature} "
+                  f"features but the slot contract is {num_feature}")
+            return GBDTRuntime(gbdt, ensemble)
         rng = np.random.RandomState(seed)
         x = rng.normal(size=(256, num_feature)).astype(np.float32)
         label = (x[:, 0] + 0.5 * x[:, min(1, num_feature - 1)]

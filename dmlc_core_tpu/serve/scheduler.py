@@ -29,6 +29,22 @@ Failure discipline (the chaos suite drives these paths):
 - shutdown fails queued-but-unbatched requests with ``Overloaded
   (shutting_down)`` rather than leaving their futures hanging.
 
+**Double-buffered dispatch** (the device-feed discipline of
+``bridge/loader.py`` applied to serving): a batch's predict is *dispatched*
+(``runtime.predict_async`` — host binning, device transfer, compute, all
+queued asynchronously) and only *synced* after the next batch has been
+assembled and dispatched, so the next batch's wire transfer hides behind
+the in-flight predict.  When the queue is idle the in-flight batch resolves
+immediately — pipelining engages exactly when there is load to pipeline,
+and light-load latency is unchanged.
+
+**Hot swap** (docs/serving.md "Model lifecycle"): :meth:`MicroBatcher.
+set_runtime` is the atomic pointer flip the model registry swaps through —
+taken under the batcher's own ``_thread_lock``, with the dispatch loop
+snapshotting ``self.runtime`` exactly once per batch, so an in-flight batch
+always finishes on the runtime it was dispatched against and no request is
+ever scored by a half-swapped model.
+
 Fault sites: ``serve.queue`` fires once per batch assembly (a ``stall``
 models a stuck consumer — the queue backs up and admission starts
 shedding); ``serve.predict`` fires before the model call (``error`` models
@@ -90,18 +106,40 @@ class _Pending:
         self.ctx = ctx
 
 
+class _InFlight:
+    """A dispatched-but-unsynced batch riding the double buffer."""
+
+    __slots__ = ("batch", "handle", "runtime", "bucket", "rows",
+                 "t_dispatch", "ctx")
+
+    def __init__(self, batch, handle, runtime, bucket, rows, t_dispatch,
+                 ctx):
+        self.batch = batch          # List[_Pending]
+        self.handle = handle        # un-synced predict result
+        self.runtime = runtime      # the runtime snapshot it ran on
+        self.bucket = bucket
+        self.rows = rows
+        self.t_dispatch = t_dispatch
+        self.ctx = ctx              # the serve.batch span's trace context
+
+
 class MicroBatcher:
     """Request coalescer + the single predict consumer thread."""
 
     def __init__(self, runtime: ModelRuntime, *, max_batch: int = 64,
                  max_delay_ms: float = 2.0,
-                 admission: Optional[AdmissionController] = None):
+                 admission: Optional[AdmissionController] = None,
+                 name: Optional[str] = None):
         if max_delay_ms < 0:
             raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
         self.runtime = runtime
+        #: the model-slot name riding every metric's ``model=`` label
+        #: (defaults to the runtime family for single-model servers, so
+        #: legacy series keys are unchanged)
+        self.name = name or runtime.name
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_ms) / 1000.0
-        self.admission = admission or AdmissionController()
+        self.admission = admission or AdmissionController(name=self.name)
         self.buckets = batch_buckets(self.max_batch)
         self._queue: "queue.Queue[_Pending]" = queue.Queue()
         self._carry: Optional[_Pending] = None  # overflow from last assembly
@@ -116,6 +154,25 @@ class MicroBatcher:
 
     def start(self) -> None:
         self._ensure_thread()
+
+    def set_runtime(self, runtime: ModelRuntime) -> None:
+        """Atomically swap the model behind the queue (the hot-swap flip).
+
+        The new runtime must honor the slot's feature contract — requests
+        already validated against ``num_feature`` may still be queued.
+        Taken under ``_thread_lock`` (the same lock submit/close/crash
+        recovery use); the dispatch loop snapshots ``self.runtime`` once
+        per batch, so in-flight batches finish on the old runtime and
+        every later batch runs entirely on the new one — there is no
+        half-swapped state a request could observe.
+        """
+        if runtime.num_feature != self.runtime.num_feature:
+            raise ValueError(
+                f"cannot swap in a runtime with num_feature="
+                f"{runtime.num_feature}; this batcher's contract is "
+                f"{self.runtime.num_feature}")
+        with self._thread_lock:
+            self.runtime = runtime
 
     def _ensure_thread(self) -> None:
         with self._thread_lock:
@@ -155,7 +212,7 @@ class MicroBatcher:
             for item in pending:
                 _fail_future(item.future, exc)
             telemetry.count("dmlc_serve_shed_total", len(pending),
-                            reason=reason)
+                            model=self.name, reason=reason)
 
     # -- producer side -------------------------------------------------------
 
@@ -193,13 +250,15 @@ class MicroBatcher:
         with self._thread_lock:
             if self._stop.is_set():
                 self.admission.release(item.nbytes)
-                telemetry.count("dmlc_serve_shed_total", reason="shutdown")
+                telemetry.count("dmlc_serve_shed_total", model=self.name,
+                                reason="shutdown")
                 raise Overloaded("server shutting down", retry_after=5.0)
             self._ensure_thread()  # self-heal a dead batcher
             # enqueue under the lock: a put after close()'s drain would
             # strand this item (future unresolved, bytes leaked)
             self._queue.put(item)
-        telemetry.gauge_set("dmlc_serve_queue_depth", self._queue.qsize())
+        telemetry.gauge_set("dmlc_serve_queue_depth", self._queue.qsize(),
+                            model=self.name)
         return item.future
 
     # -- consumer side -------------------------------------------------------
@@ -225,15 +284,39 @@ class MicroBatcher:
                     reason="predict_failed")
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            batch = self._assemble()
-            if batch:
-                self._run_batch(batch)
+        # the double buffer: at most ONE dispatched-but-unsynced batch.
+        # Under load the loop dispatches batch N+1 (its transfer queues
+        # behind N's compute) before syncing N; when the queue goes idle
+        # the in-flight batch resolves immediately (wait=False returns
+        # empty without blocking), so pipelining never delays a lone
+        # request.
+        inflight: Optional[_InFlight] = None
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = self._assemble(wait=inflight is None)
+                except BaseException:
+                    # the in-flight predict was already dispatched: sync
+                    # and answer it before the crash ferries out
+                    if inflight is not None:
+                        self._resolve(inflight)
+                        inflight = None
+                    raise
+                started = self._dispatch(batch) if batch else None
+                if inflight is not None:
+                    self._resolve(inflight)
+                inflight = started
+        finally:
+            if inflight is not None:
+                self._resolve(inflight)
 
-    def _assemble(self) -> List[_Pending]:
-        """Block for the first request, then gather until full or the
-        delay budget is spent.  An item that would overflow ``max_batch``
-        carries over as the seed of the next batch.
+    def _assemble(self, wait: bool = True) -> List[_Pending]:
+        """Gather the next batch: seed from the carry or the queue, then
+        keep gathering until full or the delay budget is spent.  An item
+        that would overflow ``max_batch`` carries over as the seed of the
+        next batch.  With ``wait=False`` (a batch is in flight) an empty
+        queue returns immediately instead of blocking — the in-flight
+        batch must resolve, not sit behind a poll timeout.
 
         Crash-safe: requests already popped when an assembly fault fires
         are failed structurally before the crash ferries out — a popped
@@ -246,7 +329,10 @@ class MicroBatcher:
                 first, self._carry = self._carry, None
             else:
                 try:
-                    first = self._queue.get(timeout=0.05)
+                    if wait:
+                        first = self._queue.get(timeout=0.05)
+                    else:
+                        first = self._queue.get_nowait()
                 except queue.Empty:
                     return []
             batch.append(first)
@@ -271,26 +357,36 @@ class MicroBatcher:
             failure = PredictFailed(f"batch assembly failed: {exc}",
                                     retry_after=2.0)
             telemetry.count("dmlc_serve_shed_total", len(batch),
-                            reason="predict_failed")
+                            model=self.name, reason="predict_failed")
             if batch:
                 self.admission.release(sum(i.nbytes for i in batch))
             for item in batch:
                 _fail_future(item.future, failure)
             raise
-        telemetry.gauge_set("dmlc_serve_queue_depth", self._queue.qsize())
+        telemetry.gauge_set("dmlc_serve_queue_depth", self._queue.qsize(),
+                            model=self.name)
         return batch
 
-    def _run_batch(self, batch: List[_Pending]) -> None:
+    def _dispatch(self, batch: List[_Pending]) -> Optional[_InFlight]:
+        """Assemble + pad one batch and dispatch its predict without
+        syncing.  Returns the in-flight handle, or ``None`` when the
+        dispatch itself failed (that batch is already failed
+        structurally; the loop continues)."""
+        # ONE runtime snapshot per batch: a concurrent set_runtime (hot
+        # swap) lands either entirely before or entirely after this batch
+        runtime = self.runtime
         n = sum(item.rows.shape[0] for item in batch)
         bucket = self.buckets[-1] if n >= self.max_batch \
             else next(b for b in self.buckets if b >= n)
         now = clock.monotonic()
         for item in batch:
             telemetry.observe("dmlc_serve_queue_seconds",
-                              now - item.enqueued_at)
+                              now - item.enqueued_at, model=self.name)
         try:
-            with telemetry.span("serve.batch", rows=n, bucket=bucket,
+            with telemetry.span("serve.batch", model=self.name, rows=n,
+                                bucket=bucket,
                                 requests=len(batch)) as batch_span:
+                ctx = tracecontext.current() if telemetry.enabled() else None
                 if telemetry.enabled():
                     # the batch belongs to no single request: it LINKS the
                     # trace of every request it coalesced, so the assembler
@@ -300,63 +396,88 @@ class MicroBatcher:
                     if linked:
                         batch_span.set(links=",".join(linked[:32]),
                                        linked_traces=len(linked))
-                x = np.zeros((bucket, self.runtime.num_feature), np.float32)
+                x = np.zeros((bucket, runtime.num_feature), np.float32)
                 ofs = 0
                 for item in batch:
                     x[ofs:ofs + item.rows.shape[0]] = item.rows
                     ofs += item.rows.shape[0]
-                fault.inject("serve.predict", model=self.runtime.name,
-                             rows=n)
+                fault.inject("serve.predict", model=runtime.name, rows=n)
                 t0 = clock.monotonic()
-                with telemetry.span("serve.predict",
-                                    model=self.runtime.name, bucket=bucket):
-                    y = self.runtime.predict(x)
-                t1 = clock.monotonic()
-                telemetry.observe("dmlc_serve_predict_seconds", t1 - t0,
-                                  model=self.runtime.name)
-                if telemetry.enabled():
-                    # per-request attribution INTO each request's own
-                    # trace: its queue wait and its share of the shared
-                    # predict call, parented under the request's
-                    # serve.request span — the two stages the critical-path
-                    # analysis splits a scored request into
-                    for item in batch:
-                        ctx = item.ctx
-                        if ctx is None or not ctx.span_id:
-                            continue
-                        telemetry.record_span(
-                            "serve.queue.wait", item.enqueued_at, now,
-                            trace=(ctx.trace_id, tracecontext.new_span_id(),
-                                   ctx.span_id))
-                        telemetry.record_span(
-                            "serve.predict", t0, t1,
-                            trace=(ctx.trace_id, tracecontext.new_span_id(),
-                                   ctx.span_id),
-                            bucket=bucket, rows=item.rows.shape[0],
-                            shared_requests=len(batch))
+                handle = runtime.predict_async(x)
         except Exception as exc:
-            telemetry.count("dmlc_serve_predict_errors_total",
-                            model=self.runtime.name)
-            telemetry.count("dmlc_serve_shed_total", len(batch),
-                            reason="predict_failed")
-            log_error(f"serve: predict failed for a {n}-row batch: {exc!r}")
-            failure = PredictFailed(f"predict failed: {exc}")
-            self.admission.release(sum(i.nbytes for i in batch))
-            for item in batch:
-                _fail_future(item.future, failure)
+            self._fail_batch(batch, n, exc)
+            return None
+        return _InFlight(batch, handle, runtime, bucket, n, t0, ctx)
+
+    def _resolve(self, f: _InFlight) -> None:
+        """Sync the in-flight predict and answer its requests — the
+        device round-trip this batch's transfer already overlapped."""
+        try:
+            y = np.asarray(f.handle)
+        except Exception as exc:
+            self._fail_batch(f.batch, f.rows, exc)
             return
-        telemetry.count("dmlc_serve_batches_total")
-        telemetry.count("dmlc_serve_rows_total", n)
-        telemetry.observe("dmlc_serve_batch_rows", n,
-                          buckets=_BATCH_ROW_BUCKETS)
+        t1 = clock.monotonic()
+        telemetry.observe("dmlc_serve_predict_seconds", t1 - f.t_dispatch,
+                          model=self.name)
+        if telemetry.enabled():
+            # the predict span (dispatch -> synced) parents under the
+            # serve.batch span it was dispatched from, even though that
+            # span closed when the double buffer moved on
+            trace = ((f.ctx.trace_id, tracecontext.new_span_id(),
+                      f.ctx.span_id) if f.ctx is not None else None)
+            telemetry.record_span("serve.predict", f.t_dispatch, t1,
+                                  trace=trace, model=self.name,
+                                  bucket=f.bucket)
+            # per-request attribution INTO each request's own trace: its
+            # queue wait and its share of the shared predict call,
+            # parented under the request's serve.request span — the two
+            # stages the critical-path analysis splits a scored request
+            # into
+            for item in f.batch:
+                ctx = item.ctx
+                if ctx is None or not ctx.span_id:
+                    continue
+                telemetry.record_span(
+                    "serve.queue.wait", item.enqueued_at, f.t_dispatch,
+                    trace=(ctx.trace_id, tracecontext.new_span_id(),
+                           ctx.span_id))
+                telemetry.record_span(
+                    "serve.predict", f.t_dispatch, t1,
+                    trace=(ctx.trace_id, tracecontext.new_span_id(),
+                           ctx.span_id),
+                    bucket=f.bucket, rows=item.rows.shape[0],
+                    shared_requests=len(f.batch))
+        telemetry.count("dmlc_serve_batches_total", model=self.name)
+        telemetry.count("dmlc_serve_rows_total", f.rows, model=self.name)
+        telemetry.observe("dmlc_serve_batch_rows", f.rows,
+                          buckets=_BATCH_ROW_BUCKETS, model=self.name)
         # one release per batch: the admission drain-rate estimate samples
         # real consumption, not the microsecond spacing of a per-item loop
-        self.admission.release(sum(i.nbytes for i in batch))
+        self.admission.release(sum(i.nbytes for i in f.batch))
+        # which model build scored this batch: the runtime snapshot's
+        # checkpoint version (stamped by the registry), annotated on the
+        # future BEFORE the result lands so a reader of the result always
+        # sees it — the transport reports it per response
+        version = getattr(f.runtime, "version", None)
         ofs = 0
-        for item in batch:
+        for item in f.batch:
             k = item.rows.shape[0]
+            item.future.dmlc_served_version = version
             _set_future(item.future, np.asarray(y[ofs:ofs + k]))
             ofs += k
+
+    def _fail_batch(self, batch: List[_Pending], n: int,
+                    exc: BaseException) -> None:
+        """Shed one poisoned batch structurally; the loop continues."""
+        telemetry.count("dmlc_serve_predict_errors_total", model=self.name)
+        telemetry.count("dmlc_serve_shed_total", len(batch),
+                        model=self.name, reason="predict_failed")
+        log_error(f"serve: predict failed for a {n}-row batch: {exc!r}")
+        failure = PredictFailed(f"predict failed: {exc}")
+        self.admission.release(sum(i.nbytes for i in batch))
+        for item in batch:
+            _fail_future(item.future, failure)
 
 
 def _set_future(future, value) -> None:

@@ -1,17 +1,22 @@
-"""The scoring transport: stdlib HTTP in front of the micro-batcher.
+"""The scoring transport: stdlib HTTP in front of the model registry.
 
 Endpoints (all JSON):
 
-- ``POST /v1/score`` — ``{"instances": [row, ...]}`` where a row is either
-  a dense ``[f0, f1, ...]`` list of ``num_feature`` numbers or a sparse
-  ``{"index": [...], "value": [...]}`` pair (feature ids in
-  ``[0, num_feature)``); answers ``{"predictions": [...], "model": ...,
-  "num_rows": n}`` or a structured error envelope (:mod:`.errors`);
-- ``GET /healthz`` — liveness + model identity;
+- ``POST /v1/score`` / ``POST /v1/score/<model>`` — ``{"instances":
+  [row, ...]}`` where a row is either a dense ``[f0, f1, ...]`` list of
+  ``num_feature`` numbers or a sparse ``{"index": [...], "value": [...]}``
+  pair (feature ids in ``[0, num_feature)``); the bare path routes to the
+  registry's default slot, the suffixed form to the named slot (unknown
+  names are a structured 404).  Answers ``{"predictions": [...],
+  "model": <slot>, "version": <checkpoint step>, "num_rows": n}`` or a
+  structured error envelope (:mod:`.errors`) — the version field is how a
+  client (and the hot-swap chaos drill) pins which model build answered;
+- ``GET /healthz`` — liveness + per-slot model identity/version;
 - ``GET /metrics`` — the telemetry registry in Prometheus text form;
 - ``GET /stats`` — the serving SLO snapshot: per-histogram count/mean and
   p50/p95/p99 derived via :func:`dmlc_core_tpu.telemetry.report.
-  estimate_quantiles` (the same math the offline report uses).
+  estimate_quantiles` (the same math the offline report uses), plus each
+  slot's identity block.
 
 Every request runs inside a ``serve.request`` telemetry span and lands in
 ``dmlc_serve_request_seconds{status=...}``; the ``serve.request`` fault
@@ -34,12 +39,10 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from dmlc_core_tpu import fault, telemetry
-from dmlc_core_tpu.serve.admission import (AdmissionController,
-                                           queue_bytes_from_env)
 from dmlc_core_tpu.serve.errors import (BadRequest, RequestTimeout,
                                         ServeError)
 from dmlc_core_tpu.serve.model_runtime import ModelRuntime
-from dmlc_core_tpu.serve.scheduler import MicroBatcher
+from dmlc_core_tpu.serve.registry import ModelRegistry, ModelSlot
 from dmlc_core_tpu.telemetry import clock, tracecontext
 from dmlc_core_tpu.telemetry.report import (REPORT_QUANTILES, _label_str,
                                             estimate_quantiles)
@@ -156,27 +159,53 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (http.server contract)
         app = self.app
-        if self.path == "/healthz":
-            self._respond_json(200, {
-                "status": "ok", "model": app.runtime.name,
-                "num_feature": app.runtime.num_feature,
-                "max_batch": app.batcher.max_batch,
-                "uptime_s": round(clock.monotonic() - app.started_at, 3)})
-        elif self.path == "/metrics":
-            self._respond(200, telemetry.prometheus_text().encode(),
-                          content_type="text/plain; version=0.0.4")
-        elif self.path == "/stats":
-            self._respond_json(200, app.stats())
-        else:
-            self._respond_error(BadRequest(f"no such path {self.path!r}"))
+        try:
+            if self.path == "/healthz":
+                default = app.registry.get()
+                self._respond_json(200, {
+                    "status": "ok", "model": default.family,
+                    "version": default.version,
+                    "num_feature": default.num_feature,
+                    "max_batch": default.batcher.max_batch,
+                    "models": app.registry.describe(),
+                    "uptime_s": round(clock.monotonic() - app.started_at,
+                                      3)})
+            elif self.path == "/metrics":
+                self._respond(200, telemetry.prometheus_text().encode(),
+                              content_type="text/plain; version=0.0.4")
+            elif self.path == "/stats":
+                self._respond_json(200, app.stats())
+            else:
+                self._respond_error(BadRequest(f"no such path "
+                                               f"{self.path!r}"))
+        except ServeError as exc:
+            # e.g. /healthz or /stats on a registry with no slots: the
+            # probe must read a structured error, not a dropped connection
+            self._respond_error(exc)
 
     def do_POST(self) -> None:  # noqa: N802 (http.server contract)
-        if self.path != "/v1/score":
-            self._respond_error(BadRequest(f"no such path {self.path!r}"))
-            return
         app = self.app
         t0 = clock.monotonic()
         status = 500
+        # route first: the per-model label every request-path metric
+        # carries must name the slot, and an unroutable request must not
+        # invent unbounded label values out of hostile paths
+        model_label = "_unrouted"
+        try:
+            slot = self._route(app)
+            model_label = slot.name
+        except ServeError as exc:
+            # the body was never read: keeping this keep-alive connection
+            # would parse it as the next request line (same discipline as
+            # every other early-response path)
+            self.close_connection = True
+            self._respond_error(exc)
+            telemetry.count("dmlc_serve_requests_total", model=model_label,
+                            status=exc.status)
+            telemetry.observe("dmlc_serve_request_seconds",
+                              clock.monotonic() - t0, model=model_label,
+                              status=exc.status)
+            return
         # continue the caller's W3C trace when one is announced: the
         # serve.request span (and everything the handler does under it —
         # batcher wait, predict share) joins the client's trace_id, which
@@ -185,13 +214,15 @@ class _Handler(BaseHTTPRequestHandler):
         # None and the request simply runs untraced (W3C: ignore, never 500)
         ctx = tracecontext.from_traceparent(self.headers.get("traceparent"))
         try:
-            with tracecontext.activate(ctx), telemetry.span("serve.request"):
+            with tracecontext.activate(ctx), \
+                    telemetry.span("serve.request", model=model_label):
                 injected = fault.http_response("serve.request")
                 if injected is not None:
                     i_status, i_headers, i_body = injected
                     status = i_status
                     if status == 503:
                         telemetry.count("dmlc_serve_shed_total",
+                                        model=model_label,
                                         reason="injected_503")
                     # the request body was never read: keeping this
                     # keep-alive connection would parse it as the next
@@ -204,7 +235,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # the connection dying mid-request (the one outcome a
                 # client counts as crashed)
                 fault.inject("serve.request")
-                status, payload, headers = self._score(app)
+                status, payload, headers = self._score(app, slot)
                 self._respond_json(status, payload, headers)
         except ServeError as exc:
             status = exc.status
@@ -227,11 +258,22 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
         finally:
-            telemetry.count("dmlc_serve_requests_total", status=status)
+            telemetry.count("dmlc_serve_requests_total", model=model_label,
+                            status=status)
             telemetry.observe("dmlc_serve_request_seconds",
-                              clock.monotonic() - t0, status=status)
+                              clock.monotonic() - t0, model=model_label,
+                              status=status)
 
-    def _score(self, app: "ScoringServer") \
+    def _route(self, app: "ScoringServer") -> ModelSlot:
+        """``/v1/score`` -> default slot; ``/v1/score/<model>`` -> named
+        slot (structured 404 for unknown names, 400 for other paths)."""
+        if self.path == "/v1/score":
+            return app.registry.get()
+        if self.path.startswith("/v1/score/"):
+            return app.registry.get(self.path[len("/v1/score/"):])
+        raise BadRequest(f"no such path {self.path!r}")
+
+    def _score(self, app: "ScoringServer", slot: ModelSlot) \
             -> Tuple[int, Dict[str, Any], Optional[Dict[str, str]]]:
         try:
             length = int(self.headers.get("Content-Length", ""))
@@ -257,12 +299,13 @@ class _Handler(BaseHTTPRequestHandler):
             obj = json.loads(raw)
         except (UnicodeDecodeError, json.JSONDecodeError) as e:
             raise BadRequest(f"body is not valid JSON: {e}") from None
-        rows = parse_instances(obj, app.runtime.num_feature)
-        future = app.batcher.submit(rows)
+        rows = parse_instances(obj, slot.num_feature)
+        future = slot.batcher.submit(rows)
         try:
             preds = future.result(timeout=app.request_timeout_s)
         except FutureTimeout:
-            telemetry.count("dmlc_serve_shed_total", reason="timeout")
+            telemetry.count("dmlc_serve_shed_total", model=slot.name,
+                            reason="timeout")
             raise RequestTimeout(
                 f"not answered within {app.request_timeout_s}s "
                 "(queue + predict)", details={
@@ -272,14 +315,28 @@ class _Handler(BaseHTTPRequestHandler):
             # finite inputs produced a non-finite score (model overflow):
             # a structured 500 beats a 200 body of RFC-invalid Infinity
             raise ServeError("model produced a non-finite prediction")
+        # the version of the runtime that actually computed these
+        # predictions (the batcher annotates it from its per-batch
+        # runtime snapshot) — NOT the slot's current version, which a
+        # swap landing mid-request could have moved past the scoring one.
+        # The hot-swap drill asserts predictions match this exact version.
+        version = getattr(future, "dmlc_served_version", None)
         return 200, {"predictions": preds.tolist(),
-                     "model": app.runtime.name,
+                     "model": slot.name,
+                     "version": version if version is not None
+                     else slot.version,
                      "num_rows": int(rows.shape[0])}, None
 
 
 class _Server(ThreadingHTTPServer):
     daemon_threads = True       # handler threads must not block shutdown
     allow_reuse_address = True
+    # socketserver's default listen backlog is 5: an open-loop burst (every
+    # client is a fresh connection) overflows it and the kernel RSTs the
+    # excess — which a client can only read as a crash.  Deep backlog +
+    # admission control is the correct order: shed with a structured 503,
+    # never with a refused connection.
+    request_queue_size = 128
 
     def handle_error(self, request, client_address) -> None:
         # default prints a traceback to stderr per dropped connection —
@@ -288,26 +345,58 @@ class _Server(ThreadingHTTPServer):
 
 
 class ScoringServer:
-    """The assembled service: runtime + batcher + admission + transport."""
+    """The assembled service: model registry + transport.
 
-    def __init__(self, runtime: ModelRuntime, *, host: str = "127.0.0.1",
+    Construct with either a single :class:`~.model_runtime.ModelRuntime`
+    (wrapped into a one-slot registry named after the runtime family —
+    the pre-lifecycle API, unchanged for existing callers) or a
+    pre-populated :class:`~.registry.ModelRegistry` whose slots carry
+    their own batch/budget knobs (the knob arguments here then apply to
+    nothing and must be left at their defaults).
+    """
+
+    def __init__(self, model: "ModelRuntime | ModelRegistry", *,
+                 host: str = "127.0.0.1",
                  port: int = 0, max_batch: int = 64,
                  max_delay_ms: float = 2.0,
                  max_queue_bytes: Optional[int] = None,
                  request_timeout_s: float = 10.0, warmup: bool = True):
-        self.runtime = runtime
+        if isinstance(model, ModelRegistry):
+            # slots already carry their own knobs: a knob passed HERE
+            # would be silently dropped — make the misuse loud instead
+            if (max_batch, max_delay_ms, max_queue_bytes) != (64, 2.0,
+                                                              None):
+                raise ValueError(
+                    "max_batch/max_delay_ms/max_queue_bytes are per-slot "
+                    "knobs: set them on registry.add(...), not on "
+                    "ScoringServer when passing a ModelRegistry")
+            self.registry = model
+        else:
+            self.registry = ModelRegistry()
+            self.registry.add(model.name, model, max_batch=max_batch,
+                              max_delay_ms=max_delay_ms,
+                              max_queue_bytes=max_queue_bytes,
+                              default=True)
         self.request_timeout_s = float(request_timeout_s)
         self._warmup = warmup
-        self.admission = AdmissionController(
-            max_queue_bytes if max_queue_bytes is not None
-            else queue_bytes_from_env())
-        self.batcher = MicroBatcher(runtime, max_batch=max_batch,
-                                    max_delay_ms=max_delay_ms,
-                                    admission=self.admission)
         self._httpd = _Server((host, port), _Handler)
         self._httpd.app = self  # type: ignore[attr-defined]
         self._serve_thread: Optional[threading.Thread] = None
         self.started_at = clock.monotonic()
+
+    # -- single-model compatibility views (the default slot's pieces) ---------
+
+    @property
+    def runtime(self) -> ModelRuntime:
+        return self.registry.get().runtime
+
+    @property
+    def batcher(self):
+        return self.registry.get().batcher
+
+    @property
+    def admission(self):
+        return self.registry.get().admission
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -319,18 +408,25 @@ class ScoringServer:
         return f"http://{host}:{port}"
 
     def start(self) -> "ScoringServer":
-        if self._warmup:
-            self.runtime.warmup(self.batcher.buckets)
-        self.batcher.start()
+        self.registry.start(warmup=self._warmup)
         self.started_at = clock.monotonic()
         self._serve_thread = threading.Thread(
             target=self._serve, name="serve-http", daemon=False)
         self._serve_thread.start()
-        log_info(f"serve: listening on {self.url} "
-                 f"(model={self.runtime.name}, "
-                 f"max_batch={self.batcher.max_batch}, "
-                 f"max_delay_ms={self.batcher.max_delay_s * 1e3:g}, "
-                 f"max_queue_bytes={self.admission.max_queue_bytes})")
+        names = self.registry.names()
+        if names:
+            default = self.registry.get()
+            log_info(f"serve: listening on {self.url} "
+                     f"(models={names}, "
+                     f"default={default.name}:{default.family}, "
+                     f"max_batch={default.batcher.max_batch}, "
+                     f"max_delay_ms={default.batcher.max_delay_s * 1e3:g}, "
+                     f"max_queue_bytes={default.admission.max_queue_bytes})")
+        else:
+            # an empty registry can still serve /metrics and structured
+            # 404s — a deploy that adds slots before routing traffic
+            log_info(f"serve: listening on {self.url} (no models "
+                     "registered yet)")
         return self
 
     def _serve(self) -> None:
@@ -345,7 +441,7 @@ class ScoringServer:
             self._serve_thread.join(10.0)
             self._serve_thread = None
         self._httpd.server_close()
-        self.batcher.close()
+        self.registry.close()
 
     def __enter__(self) -> "ScoringServer":
         return self.start()
@@ -358,10 +454,17 @@ class ScoringServer:
     def stats(self) -> Dict[str, Any]:
         """Live serving stats: counters + histogram quantiles, the same
         estimates the offline ``telemetry report`` prints."""
+        default = self.registry.get()
         out: Dict[str, Any] = {
-            "model": self.runtime.name,
-            "queue_bytes": self.admission.queued_bytes,
-            "max_queue_bytes": self.admission.max_queue_bytes,
+            "model": default.family,
+            "version": default.version,
+            "models": {
+                name: dict(info,
+                           queue_bytes=self.registry.get(name)
+                           .admission.queued_bytes)
+                for name, info in self.registry.describe().items()},
+            "queue_bytes": default.admission.queued_bytes,
+            "max_queue_bytes": default.admission.max_queue_bytes,
             "uptime_s": round(clock.monotonic() - self.started_at, 3),
             "metrics": {},
         }

@@ -468,3 +468,85 @@ def test_epoch_producer_recovers_through_threadediter():
         assert [it.next(), it.next(), it.next()] == ["a", "b", None]
     finally:
         it.destroy()
+
+
+# -- serving-side skew-free contract (ISSUE 15) -------------------------------
+# The model-lifecycle subsystem serves GBDT requests through the same
+# uint8 binned wire training uses.  These tests pin the three-way
+# bitwise identity: serving binner == training-time apply_bins ==
+# float-path predict, for a runtime restored from a checkpoint.
+
+_SERVING_RUNTIMES = {}
+
+
+def _serving_runtime(handle_missing, num_feature=7, seed=0):
+    """One trained GBDT runtime per config, memoized: the fit (a full jit
+    compile) costs seconds and every test here only READS the model."""
+    from dmlc_core_tpu.serve.model_runtime import GBDTRuntime
+
+    key = (handle_missing, num_feature, seed)
+    if key not in _SERVING_RUNTIMES:
+        x, y = make_xy(n=600, f=num_feature, seed=seed,
+                       nan_rate=0.15 if handle_missing else 0.0)
+        gbdt = GBDT(GBDTParam(objective="logistic", num_boost_round=5,
+                              max_depth=3, num_bins=64,
+                              handle_missing=handle_missing), num_feature)
+        gbdt.make_bins(x)
+        ensemble, _ = gbdt.fit_binned(gbdt.bin_features(x), y)
+        _SERVING_RUNTIMES[key] = (GBDTRuntime(gbdt, ensemble), x)
+    return _SERVING_RUNTIMES[key]
+
+
+@pytest.mark.parametrize("handle_missing", [False, True])
+def test_serving_binner_bitwise_equal_training_apply_bins(handle_missing):
+    rt, x = _serving_runtime(handle_missing)
+    # adversarial rows: exact boundary values (ties go right), +-inf,
+    # NaN, and all-zero padding rows like the scheduler emits
+    probe = np.array(x[:50])
+    probe[0, :] = rt.gbdt.boundaries[np.arange(x.shape[1]), 0]
+    probe[1, :] = rt.gbdt.boundaries[np.arange(x.shape[1]), -1]
+    probe[2, :] = np.inf
+    probe[3, :] = -np.inf
+    probe[4, :] = 0.0
+    if handle_missing:
+        probe[5, :] = np.nan
+    miss = (rt.gbdt.param.num_bins - 1 if handle_missing else None)
+    want = np.asarray(apply_bins(probe, rt.gbdt.boundaries,
+                                 missing_bin=miss))
+    got = rt.binner.transform(probe)
+    # identical ids — the serving wire applies the exact training binning
+    np.testing.assert_array_equal(got.astype(np.int32), want)
+    assert got.dtype == wire_dtype(rt.gbdt.param.num_bins)
+
+
+@pytest.mark.parametrize("handle_missing", [False, True])
+def test_serving_uint8_path_bitwise_equal_float_predict(handle_missing):
+    rt, x = _serving_runtime(handle_missing)
+    probe = np.array(x[:40])
+    probe[0, :] = rt.gbdt.boundaries[np.arange(x.shape[1]), 0]
+    if handle_missing:
+        probe[1, :] = np.nan
+    got = rt.predict(probe)            # uint8 wire, widened in-jit
+    want = rt.predict_float(probe)     # device-side float binning
+    np.testing.assert_array_equal(got, want)
+
+
+def test_serving_checkpoint_restore_keeps_the_skew_contract(tmp_path):
+    # the swapped-in model (restored from a serving_state checkpoint)
+    # still satisfies both identities — what the watcher actually serves
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+    from dmlc_core_tpu.serve.model_runtime import build_runtime
+
+    rt, x = _serving_runtime(False)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, rt.gbdt.serving_state(rt.ensemble), async_=False)
+    restored = build_runtime("gbdt", x.shape[1],
+                             checkpoint=mgr.step_uri(1))
+    probe = x[:25]
+    np.testing.assert_array_equal(
+        restored.binner.transform(probe),
+        rt.binner.transform(probe))
+    np.testing.assert_array_equal(restored.predict(probe),
+                                  rt.predict(probe))
+    np.testing.assert_array_equal(restored.predict(probe),
+                                  restored.predict_float(probe))

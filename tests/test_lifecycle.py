@@ -1,0 +1,612 @@
+"""Model-lifecycle tests: registry routing, the checkpoint watcher's
+watch→validate→warmup→swap state machine, hot-swap atomicity under chaos,
+and the GBDT serving round-trip (ISSUE 15).
+
+The invariants under test, from docs/serving.md "Model lifecycle":
+
+- a partially written checkpoint (no manifest yet) is never even opened;
+- corrupt/truncated bytes are rejected by CRC before any jax work, and
+  **previous-good keeps serving** across every failed validation;
+- the swap is a pointer flip: in-flight batches finish on the old
+  runtime, no request is dropped, crashed, or answered by a
+  half-swapped model (every 200 carries the version that actually
+  scored it, and its predictions match that version bitwise);
+- GBDT checkpoints are self-describing (trees + binner edges in one
+  blob) and serving goes through the uint8 binned wire, bitwise-equal
+  to the float path.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.bridge.checkpoint import (CheckpointCorruptError,
+                                             CheckpointManager,
+                                             save_checkpoint,
+                                             verify_checkpoint)
+from dmlc_core_tpu.serve import (CheckpointWatcher, MicroBatcher,
+                                 ModelRegistry, ModelRuntime, ScoringServer,
+                                 UnknownModel, build_runtime,
+                                 runtime_builder)
+from dmlc_core_tpu.serve.loadgen import run_load
+from dmlc_core_tpu.utils.logging import Error as CheckError
+
+NF = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _sigmoid(v: float) -> float:
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def _bias_for(step: int) -> float:
+    """Well-separated per-version bias: a w=0 logistic linear model then
+    predicts exactly sigmoid(bias) for EVERY row — the prediction value
+    IS the model version, which is what lets the chaos drill detect a
+    response scored by a model other than the one it claims."""
+    return -2.0 + 0.5 * step
+
+
+def _publish_linear(mgr: CheckpointManager, step: int,
+                    num_feature: int = NF) -> None:
+    """One training iteration's output: a linear checkpoint whose every
+    prediction identifies ``step``."""
+    mgr.save(step, {"w": np.zeros(num_feature, np.float32),
+                    "b": np.float32(_bias_for(step))}, async_=False)
+
+
+def _post(url, path, obj, timeout=10.0):
+    body = obj if isinstance(obj, bytes) else json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url + path, data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+class _CountingBuilder:
+    """Wraps runtime_builder and counts how often a candidate is built —
+    the probe for "a partial/known-bad checkpoint is never (re)opened"."""
+
+    def __init__(self, kind="linear", num_feature=NF):
+        self._build = runtime_builder(kind, num_feature)
+        self.calls = 0
+
+    def __call__(self, uri):
+        self.calls += 1
+        return self._build(uri)
+
+
+# -- registry routing ---------------------------------------------------------
+
+def test_registry_routing_and_multi_model_http():
+    registry = ModelRegistry()
+    registry.add("alpha", build_runtime("linear", NF, seed=0),
+                 max_batch=8, max_delay_ms=1.0, default=True)
+    registry.add("beta", build_runtime("mlp", NF, seed=1, hidden="8",
+                                       num_class=3),
+                 max_batch=4, max_delay_ms=1.0)
+    with ScoringServer(registry, request_timeout_s=10.0) as srv:
+        row = [[0.1] * NF]
+        status, body = _post(srv.url, "/v1/score", {"instances": row})
+        assert status == 200 and body["model"] == "alpha"
+        assert "version" in body
+        status, body = _post(srv.url, "/v1/score/beta", {"instances": row})
+        assert status == 200 and body["model"] == "beta"
+        assert len(body["predictions"][0]) == 3  # the mlp's class probs
+        # unknown model: structured 404 naming what IS registered
+        status, body = _post(srv.url, "/v1/score/nope", {"instances": row})
+        assert status == 404
+        assert body["error"]["code"] == "unknown_model"
+        assert body["error"]["details"]["models"] == ["alpha", "beta"]
+        # healthz + stats carry the per-slot identity blocks
+        with urllib.request.urlopen(srv.url + "/healthz", timeout=5) as r:
+            health = json.load(r)
+        assert set(health["models"]) == {"alpha", "beta"}
+        assert health["models"]["beta"]["family"] == "mlp"
+
+
+def test_registry_duplicate_and_unknown_slots():
+    registry = ModelRegistry()
+    with pytest.raises(UnknownModel):
+        registry.get()  # nothing registered at all
+    registry.add("m", build_runtime("linear", 4, seed=0))
+    with pytest.raises(CheckError, match="already registered"):
+        registry.add("m", build_runtime("linear", 4, seed=0))
+    with pytest.raises(UnknownModel):
+        registry.get("other")
+    registry.close()
+
+
+def test_per_model_admission_budgets_are_independent():
+    row_bytes = NF * 4
+    registry = ModelRegistry()
+    registry.add("big", build_runtime("linear", NF, seed=0),
+                 max_batch=8, max_delay_ms=1.0, default=True)
+    # a budget of ONE row: any 2-row request to this slot is oversized
+    registry.add("tiny", build_runtime("linear", NF, seed=0),
+                 max_batch=8, max_delay_ms=1.0, max_queue_bytes=row_bytes)
+    with ScoringServer(registry) as srv:
+        two_rows = {"instances": [[0.0] * NF, [0.0] * NF]}
+        status, body = _post(srv.url, "/v1/score/tiny", two_rows)
+        assert status == 400  # bigger than the slot's whole budget
+        # the SAME request against the co-hosted default slot just works:
+        # one model's budget never sheds a neighbour's traffic
+        status, body = _post(srv.url, "/v1/score/big", two_rows)
+        assert status == 200 and len(body["predictions"]) == 2
+
+
+# -- manifest-first + validation ---------------------------------------------
+
+def test_manifest_publishes_after_blob_and_retention_removes_it(tmp_path):
+    import time
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=1)
+    _publish_linear(mgr, 1)
+    m = mgr.read_manifest(1)
+    assert m is not None and m["step"] == 1 and m["nbytes"] > 0
+    # written_at is the CURRENT wall time (not the process-start anchor
+    # — a long trainer's manifests must not all carry one timestamp)
+    assert abs(m["written_at"] - time.time()) < 60
+    verify_checkpoint(mgr.step_uri(1), m)  # round-trips clean
+    _publish_linear(mgr, 2)
+    assert mgr.all_steps() == [2]
+    assert mgr.read_manifest(1) is None  # retention removed both files
+
+
+def test_partial_checkpoint_without_manifest_is_never_opened(tmp_path):
+    registry = ModelRegistry()
+    registry.add("m", build_runtime("linear", NF, seed=0), version=0,
+                 max_batch=4, max_delay_ms=1.0)
+    registry.start(warmup=False)
+    try:
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        builder = _CountingBuilder()
+        watcher = CheckpointWatcher(registry, "m", mgr.directory, builder,
+                                    poll_s=60.0, manager=mgr)
+        # a blob with NO manifest beside it == a write still in flight
+        import os
+
+        os.makedirs(mgr.directory, exist_ok=True)
+        save_checkpoint(mgr.step_uri(1),
+                        {"w": np.zeros(NF, np.float32), "b": np.float32(0)})
+        assert watcher.poll_once() is None
+        assert builder.calls == 0  # never even opened
+        # the manager's own save publishes the manifest -> next poll swaps
+        _publish_linear(mgr, 2)
+        assert watcher.poll_once() == 2
+        assert builder.calls == 1
+        assert registry.get("m").version == 2
+    finally:
+        registry.close()
+
+
+def test_corrupt_checkpoint_rejected_previous_good_keeps_serving(tmp_path):
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    registry = ModelRegistry()
+    registry.add("m", build_runtime("linear", NF, seed=0), version=0,
+                 max_batch=4, max_delay_ms=1.0)
+    registry.start(warmup=False)
+    try:
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep=10)
+        builder = _CountingBuilder()
+        watcher = CheckpointWatcher(registry, "m", mgr.directory, builder,
+                                    poll_s=60.0, manager=mgr)
+        _publish_linear(mgr, 1)
+        assert watcher.poll_once() == 1
+        x = np.full((1, NF), 0.0, np.float32)
+        v1_pred = registry.get("m").runtime.predict(x)[0]
+        assert v1_pred == pytest.approx(_sigmoid(_bias_for(1)), rel=1e-5)
+
+        # step 2 lands durable... then bit-rots on the store
+        _publish_linear(mgr, 2)
+        blob = mgr.step_uri(2)
+        with open(blob, "r+b") as f:
+            f.seek(30)
+            f.write(b"\xff")
+        calls_before = builder.calls
+        assert watcher.poll_once() is None
+        # rejected by CRC BEFORE any model build
+        assert builder.calls == calls_before
+        slot = registry.get("m")
+        assert slot.version == 1  # previous-good untouched
+        assert slot.runtime.predict(x)[0] == v1_pred
+        reg = telemetry.get_registry()
+        assert reg.counter("dmlc_serve_swap_total", model="m",
+                           outcome="failed").value >= 1
+        assert reg.counter("dmlc_serve_swap_failures_total", model="m",
+                           stage="validate").value >= 1
+        # the known-bad candidate is not re-validated every poll
+        assert watcher.poll_once() is None
+        assert builder.calls == calls_before
+        # a fresh good step recovers
+        _publish_linear(mgr, 3)
+        assert watcher.poll_once() == 3
+        assert registry.get("m").version == 3
+    finally:
+        registry.close()
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_rejected_newest_falls_back_to_older_valid_step(tmp_path):
+    """Newest-first WITH fallback: a corrupt newest step must not pin the
+    slot to stale previous-good when an older valid unswapped step sits
+    in the store (trainer published v2, then a corrupt v3, then died)."""
+    registry = ModelRegistry()
+    registry.add("m", build_runtime("linear", NF, seed=0), version=1,
+                 max_batch=4, max_delay_ms=1.0)
+    registry.start(warmup=False)
+    try:
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep=10)
+        _publish_linear(mgr, 2)
+        _publish_linear(mgr, 3)
+        with open(mgr.step_uri(3), "r+b") as f:
+            f.seek(25)
+            f.write(b"\x00\xff")
+        watcher = CheckpointWatcher(registry, "m", mgr.directory,
+                                    _CountingBuilder(), poll_s=60.0,
+                                    manager=mgr)
+        assert watcher.poll_once() is None   # newest (3) rejected by CRC
+        assert watcher.rejections == 1
+        # next poll falls back past the known-bad step to valid v2
+        assert watcher.poll_once() == 2
+        assert registry.get("m").version == 2
+        # and a later repaired/newer step still wins
+        _publish_linear(mgr, 4)
+        assert watcher.poll_once() == 4
+    finally:
+        registry.close()
+
+
+def test_scoring_server_rejects_per_slot_knobs_with_registry():
+    registry = ModelRegistry()
+    registry.add("m", build_runtime("linear", 4, seed=0))
+    try:
+        with pytest.raises(ValueError, match="per-slot"):
+            ScoringServer(registry, max_batch=128)
+    finally:
+        registry.close()
+
+
+def test_healthz_on_empty_registry_is_structured_not_a_crash():
+    with ScoringServer(ModelRegistry()) as srv:
+        try:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=5)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+            assert json.load(e)["error"]["code"] == "unknown_model"
+
+
+def test_truncated_checkpoint_rejected_by_byte_count(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    _publish_linear(mgr, 1)
+    m = mgr.read_manifest(1)
+    with open(mgr.step_uri(1), "r+b") as f:
+        f.truncate(m["nbytes"] - 7)
+    with pytest.raises(CheckpointCorruptError, match="truncated|bytes"):
+        verify_checkpoint(mgr.step_uri(1), m)
+
+
+def test_watcher_rejects_feature_contract_mismatch(tmp_path):
+    registry = ModelRegistry()
+    registry.add("m", build_runtime("linear", NF, seed=0), version=0,
+                 max_batch=4, max_delay_ms=1.0)
+    registry.start(warmup=False)
+    try:
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        # a checkpoint trained with the WRONG width
+        mgr.save(5, {"w": np.zeros(NF + 3, np.float32),
+                     "b": np.float32(0.0)}, async_=False)
+        watcher = CheckpointWatcher(registry, "m", mgr.directory,
+                                    _CountingBuilder(), poll_s=60.0,
+                                    manager=mgr)
+        assert watcher.poll_once() is None
+        assert registry.get("m").version == 0
+    finally:
+        registry.close()
+
+
+# -- swap atomicity -----------------------------------------------------------
+
+class _GateRuntime(ModelRuntime):
+    """Constant-score runtime whose predict announces itself and can be
+    held open — the probe for in-flight-batch/swap interleaving."""
+
+    name = "gate"
+
+    def __init__(self, value, num_feature=4, hold_s=0.0):
+        super().__init__(num_feature)
+        self.value = float(value)
+        self.hold_s = hold_s
+        self.entered = threading.Event()
+
+    def predict(self, x):
+        self.entered.set()
+        if self.hold_s:
+            import time
+
+            time.sleep(self.hold_s)
+        return np.full(x.shape[0], self.value, np.float32)
+
+
+def test_inflight_batch_finishes_on_old_runtime_next_on_new():
+    old = _GateRuntime(1.0, hold_s=0.4)
+    new = _GateRuntime(2.0)
+    mb = MicroBatcher(old, max_batch=4, max_delay_ms=1.0, name="m")
+    mb.start()
+    try:
+        f1 = mb.submit(np.zeros((1, 4), np.float32))
+        assert old.entered.wait(5.0)  # batch 1 is inside old.predict
+        mb.set_runtime(new)           # the pointer flip, mid-flight
+        f2 = mb.submit(np.zeros((1, 4), np.float32))
+        # the in-flight batch finished on the OLD runtime...
+        np.testing.assert_array_equal(f1.result(timeout=10), [1.0])
+        # ...and everything after runs whole on the new one
+        np.testing.assert_array_equal(f2.result(timeout=10), [2.0])
+    finally:
+        mb.close()
+
+
+def test_set_runtime_refuses_feature_mismatch():
+    mb = MicroBatcher(_GateRuntime(1.0, num_feature=4), max_batch=2,
+                      max_delay_ms=1.0)
+    with pytest.raises(ValueError, match="num_feature"):
+        mb.set_runtime(_GateRuntime(2.0, num_feature=5))
+
+
+# -- GBDT: self-describing checkpoint + binned serving (the skew contract) ----
+
+_TRAINED_GBDTS = {}
+
+
+def _train_gbdt(num_feature=6, handle_missing=False, seed=0):
+    """Memoized per config: the fit is a whole-program jit compile and
+    every caller only reads the trained model."""
+    from dmlc_core_tpu.models.gbdt import GBDT, GBDTParam
+
+    key = (num_feature, handle_missing, seed)
+    if key in _TRAINED_GBDTS:
+        return _TRAINED_GBDTS[key]
+    rng = np.random.RandomState(seed)
+    x = rng.normal(size=(400, num_feature)).astype(np.float32)
+    if handle_missing:
+        x[rng.rand(*x.shape) < 0.1] = np.nan
+    label = (np.nan_to_num(x[:, 0]) + 0.3 * np.nan_to_num(x[:, 1])
+             > 0).astype(np.float32)
+    gbdt = GBDT(GBDTParam(objective="logistic", num_boost_round=6,
+                          max_depth=3, num_bins=32,
+                          handle_missing=handle_missing), num_feature)
+    gbdt.make_bins(x)
+    ensemble, _ = gbdt.fit_binned(gbdt.bin_features(x), label)
+    _TRAINED_GBDTS[key] = (gbdt, ensemble)
+    return gbdt, ensemble
+
+
+@pytest.mark.parametrize("handle_missing", [False, True])
+def test_gbdt_checkpoint_roundtrip_bitwise(tmp_path, handle_missing):
+    from dmlc_core_tpu.serve.model_runtime import GBDTRuntime
+
+    gbdt, ensemble = _train_gbdt(handle_missing=handle_missing)
+    mgr = CheckpointManager(str(tmp_path / "gb"))
+    mgr.save(1, gbdt.serving_state(ensemble), async_=False)
+
+    rt = build_runtime("gbdt", 6, checkpoint=mgr.step_uri(1))
+    assert isinstance(rt, GBDTRuntime)
+    rng = np.random.RandomState(7)
+    x = rng.normal(size=(33, 6)).astype(np.float32)
+    if handle_missing:
+        x[rng.rand(*x.shape) < 0.15] = np.nan
+    # boundary values: exactly on the learned edges (ties go right — the
+    # worst case for any binning skew)
+    x[0, :] = gbdt.boundaries[np.arange(6), 0]
+    want = np.asarray(gbdt.predict(ensemble, gbdt.bin_features(x)))
+    got = rt.predict(x)
+    # the restored model is bit-identical, through the uint8 wire
+    np.testing.assert_array_equal(got, want)
+    # and the restored binner edges are the trained ones, bit for bit
+    np.testing.assert_array_equal(rt.binner.boundaries, gbdt.boundaries)
+
+
+def test_gbdt_watcher_hot_swaps_trained_model(tmp_path):
+    """The closed train→serve loop: a freshly trained GBDT lands as a
+    checkpoint and the watcher serves it, through the binned wire."""
+    registry = ModelRegistry()
+    # day-0 model: a linear placeholder — the swap only pins the feature
+    # contract, so a gbdt can replace it (cross-family swap)
+    registry.add("champion", build_runtime("linear", 6, seed=3), version=0,
+                 max_batch=4, max_delay_ms=1.0)
+    registry.start(warmup=False)
+    try:
+        gbdt, ensemble = _train_gbdt(num_feature=6)  # cache-shared fit
+        mgr = CheckpointManager(str(tmp_path / "gb"))
+        mgr.save(1, gbdt.serving_state(ensemble), async_=False)
+        watcher = CheckpointWatcher(registry, "champion", mgr.directory,
+                                    runtime_builder("gbdt", 6),
+                                    poll_s=60.0, manager=mgr)
+        assert watcher.poll_once() == 1
+        x = np.random.RandomState(5).normal(size=(9, 6)).astype(np.float32)
+        want = np.asarray(gbdt.predict(ensemble, gbdt.bin_features(x)))
+        got = registry.get("champion").runtime.predict(x)
+        np.testing.assert_array_equal(got, want)
+    finally:
+        registry.close()
+
+
+# -- the headline chaos drill -------------------------------------------------
+
+def _version_consistency_check(payload):
+    """Every prediction in a 200 must equal sigmoid(bias(version)) for the
+    version the response claims served it — the probe that would catch a
+    half-swapped or mixed-version answer."""
+    v = payload.get("version")
+    if not isinstance(v, int):
+        return False
+    want = _sigmoid(_bias_for(v))
+    return all(abs(p - want) < 1e-5 for p in payload["predictions"])
+
+
+@pytest.mark.chaos
+def test_hot_swap_storm_zero_crashed_zero_half_swapped(tmp_path):
+    """N hot swaps during a 503 storm + injected swap-stage faults: zero
+    crashed requests, zero responses from a half-swapped or mixed-version
+    model, one candidate rejected mid-campaign with previous-good
+    serving, and >= 2 swaps completed."""
+    fault.configure({
+        "seed": 17,
+        "rules": [
+            {"site": "serve.request", "kind": "http_status", "status": 503,
+             "headers": {"retry-after": "1"},
+             "body": json.dumps({"error": {"code": "overloaded",
+                                           "message": "storm",
+                                           "retry_after": 1}}),
+             "after": 10, "times": 12},
+            {"site": "serve.request", "kind": "stall", "seconds": 0.02,
+             "probability": 0.2, "times": None},
+            # ONE candidate dies in validation (previous-good must keep
+            # serving; a later step recovers).  Listed BEFORE the jitter
+            # rule: select() fires the first eligible rule per hit
+            {"site": "serve.swap", "kind": "error",
+             "exception": "RuntimeError", "message": "killed validation",
+             "match": {"stage": "validate"}, "after": 1, "times": 1},
+            # ...and every swap stage jitters
+            {"site": "serve.swap", "kind": "stall", "seconds": 0.05,
+             "probability": 0.5, "times": None},
+        ]})
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=10)
+    _publish_linear(mgr, 1)
+    registry = ModelRegistry()
+    # day-0 model IS version 1 (restored from its checkpoint), so every
+    # response in the campaign — before, during, and after each swap —
+    # must satisfy the version-consistency probe
+    registry.add("champion",
+                 build_runtime("linear", NF,
+                               checkpoint=mgr.step_uri(1)),
+                 version=1, max_batch=8, max_delay_ms=1.0, default=True)
+    with ScoringServer(registry, request_timeout_s=8.0) as srv:
+        watcher = CheckpointWatcher(registry, "champion", mgr.directory,
+                                    runtime_builder("linear", NF),
+                                    poll_s=0.1, manager=mgr)
+        publish_error = []
+
+        def _publisher():
+            # the "trainer": each new version is published only after the
+            # watcher has consumed the previous one (swapped OR rejected)
+            # — the watcher is latest-wins, so un-paced publishes would
+            # legitimately skip intermediate steps and the injected
+            # validation kill could land on the final one
+            try:
+                import time
+
+                for step in (2, 3, 4):
+                    time.sleep(0.3)
+                    progress = (watcher.swaps_completed
+                                + watcher.rejections)
+                    _publish_linear(mgr, step)
+                    deadline = time.monotonic() + 20
+                    while (watcher.swaps_completed + watcher.rejections
+                           <= progress and time.monotonic() < deadline):
+                        time.sleep(0.05)
+            except Exception as e:  # pragma: no cover - surfaced below
+                publish_error.append(e)
+
+        trainer = threading.Thread(target=_publisher)
+        with watcher:
+            trainer.start()
+            # 50 qps is plenty to keep batches in flight across every
+            # swap; the drill's teeth are the consistency probe and the
+            # storm, not raw load (the box may be running a whole suite)
+            report = run_load(srv.url, qps=50, duration_s=3.0,
+                              num_feature=NF, seed=23, timeout_s=8.0,
+                              model="champion",
+                              response_check=_version_consistency_check)
+            trainer.join(80)
+            # let the watcher catch the last published step
+            deadline = 100
+            import time
+
+            while registry.get("champion").version < 4 and deadline > 0:
+                time.sleep(0.1)
+                deadline -= 1
+        assert not publish_error
+        counts = report["counts"]
+        assert counts["crashed"] == 0 and counts["error"] == 0
+        # ZERO responses inconsistent with the version that scored them:
+        # no request ever saw a half-swapped model
+        assert counts["invalid"] == 0
+        assert counts["ok"] > 0
+        assert counts["shed"] >= 12  # the storm surfaced structurally
+        assert watcher.swaps_completed >= 2
+        final = registry.get("champion")
+        # step 2 (the killed validation) was rejected; the service ended
+        # on a GOOD later step, never stuck on the rejected one
+        assert final.version in (3, 4)
+        fired = {(site, kind) for site, kind, _ in fault.fires()}
+        assert ("serve.swap", "error") in fired
+        assert ("serve.request", "http_status") in fired
+    reg_steps = mgr.all_steps()
+    assert reg_steps[-1] == 4
+
+
+# -- observability ------------------------------------------------------------
+
+class _EmptyFamily:
+    def samples(self):
+        return []
+
+
+def test_swap_spans_and_metrics_recorded(tmp_path):
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        reg = telemetry.get_registry()
+        # the registry is process-global: assert DELTAS, not totals
+        ok_before = reg.counter("dmlc_serve_swap_total", model="m",
+                                outcome="ok").value
+        fam_count = sum(
+            child.count for _, child in next(
+                (f for f in reg.families()
+                 if f.name == "dmlc_serve_swap_seconds"),
+                _EmptyFamily()).samples())
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        _publish_linear(mgr, 1)
+        registry = ModelRegistry()
+        registry.add("m", build_runtime("linear", NF, seed=0), version=0,
+                     max_batch=4, max_delay_ms=1.0)
+        registry.start(warmup=False)
+        try:
+            watcher = CheckpointWatcher(registry, "m", mgr.directory,
+                                        _CountingBuilder(), poll_s=60.0,
+                                        manager=mgr)
+            assert watcher.poll_once() == 1
+        finally:
+            registry.close()
+        names = {e["name"] for e in telemetry.get_tracer().events()}
+        assert {"model.watch", "model.validate", "model.warmup",
+                "model.swap"} <= names
+        assert reg.counter("dmlc_serve_swap_total", model="m",
+                           outcome="ok").value == ok_before + 1
+        assert reg.gauge("dmlc_serve_swap_version", model="m").value == 1.0
+        fam = next(f for f in reg.families()
+                   if f.name == "dmlc_serve_swap_seconds")
+        assert sum(child.count
+                   for _, child in fam.samples()) == fam_count + 1
+    finally:
+        if not was_enabled:
+            telemetry.disable()

@@ -284,6 +284,21 @@ def test_gbdt_runtime_predicts_probabilities():
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def test_gbdt_runtime_serves_through_the_binned_wire_skew_free():
+    # the skew-free contract (ISSUE 15): serving scores ride the uint8
+    # HostBinner wire and are BITWISE-equal to the float-path predict —
+    # including on exact boundary values, where any binning skew would
+    # flip a split decision
+    rt = build_runtime("gbdt", 4, seed=2)
+    assert rt.binner.dtype == np.uint8  # 16 bins fit the narrowest wire
+    x = np.random.RandomState(3).normal(size=(12, 4)).astype(np.float32)
+    x[0, :] = rt.gbdt.boundaries[np.arange(4), 0]   # ties go right
+    x[1, :] = rt.gbdt.boundaries[np.arange(4), -1]
+    np.testing.assert_array_equal(rt.predict(x), rt.predict_float(x))
+    # and the wire really is the narrow dtype end to end
+    assert rt.binner.transform(x).dtype == np.uint8
+
+
 def test_runtime_warmup_compiles_each_bucket_once():
     rt = StubRuntime(num_feature=3)
     assert rt.warmup([1, 2, 4, 4, 2]) == 3
@@ -311,6 +326,9 @@ def test_http_score_dense_and_sparse(linear_server):
     status, body = post(url, {"instances": [[0.5, 0.5, 0.5, 0.5]]})
     assert status == 200
     assert body["model"] == "linear" and body["num_rows"] == 1
+    # every response names the model version that scored it (the
+    # lifecycle drill's atomicity probe; 0 = unmanaged/day-0)
+    assert body["version"] == 0
     assert len(body["predictions"]) == 1
     # the sparse form of the same row scores identically
     status, sparse = post(url, {"instances": [
@@ -357,10 +375,15 @@ def test_http_healthz_and_stats(linear_server):
         assert stats["model"] == "linear"
         series = stats["metrics"]
         # series names render exactly as the offline report's table keys
-        assert series['dmlc_serve_requests_total{status="200"}'] >= 1
-        hist = series['dmlc_serve_request_seconds{status="200"}']
+        # (every request-path metric carries the model-slot label)
+        key = 'dmlc_serve_requests_total{model="linear",status="200"}'
+        assert series[key] >= 1
+        hist = series['dmlc_serve_request_seconds'
+                      '{model="linear",status="200"}']
         assert hist["count"] >= 1 and hist["p50"] is not None
         assert hist["p50"] <= hist["p99"]
+        # the per-slot identity block rides /stats too
+        assert stats["models"]["linear"]["family"] == "linear"
     finally:
         if not was_enabled:
             telemetry.disable()
@@ -427,6 +450,30 @@ def test_http_keepalive_connection_stays_in_sync(linear_server):
             resp = conn.getresponse()
             assert resp.status == 200
             assert len(json.load(resp)["predictions"]) == 1
+    finally:
+        conn.close()
+
+
+def test_http_unknown_model_404_closes_keepalive_connection(linear_server):
+    # the route-error path answers WITHOUT reading the body: keeping the
+    # keep-alive connection would parse that unread body as the next
+    # request line, so the 404 must close the connection
+    import http.client
+
+    host, port = linear_server.address
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    try:
+        body = json.dumps({"instances": [[0.0] * 4]})
+        conn.request("POST", "/v1/score/nope", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 404
+        assert json.load(resp)["error"]["code"] == "unknown_model"
+        with pytest.raises((http.client.HTTPException, ConnectionError,
+                            OSError)):
+            conn.request("POST", "/v1/score", body=body,
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse()
     finally:
         conn.close()
 

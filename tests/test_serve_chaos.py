@@ -201,7 +201,9 @@ def test_shed_and_fault_counters_reach_telemetry():
             status, _, _ = _post(srv.url, {"instances": [[0.0] * NF]})
             assert status == 503
         reg = telemetry.get_registry()
-        assert reg.counter("dmlc_serve_shed_total",
+        # serve metrics carry the model-slot label (defaults to the
+        # runtime family on a single-model server)
+        assert reg.counter("dmlc_serve_shed_total", model="linear",
                            reason="predict_failed").value >= 1
         assert reg.counter("dmlc_fault_injected_total",
                            site="serve.predict", kind="error").value >= 1
