@@ -10,10 +10,14 @@ Two kinds of passes run:
 
 - **per-file passes** (lockset/purity/resources/protocol/transport) see one
   module at a time;
-- **project passes** (deadlock/contracts/escape/jaxbound) see the whole
-  repo at once through the :mod:`.graph` call-graph core — they run on the
-  default (unscoped) gate invocation, or whenever ``--pass`` selects them
-  explicitly.
+- **project passes** (deadlock/contracts/escape/jaxbound/races/wiretaint)
+  see the whole repo at once through the :mod:`.graph` call-graph core —
+  they run on the default (unscoped) gate invocation, or whenever
+  ``--pass`` selects them explicitly.
+
+``--jobs N`` fans the per-file stage out over a process pool (findings
+are reassembled in file order, so output is byte-identical to a serial
+run); project passes stay sequential — they need the whole graph.
 
 ``--format github`` renders new findings as GitHub workflow annotations;
 ``--format sarif`` emits a SARIF 2.1.0 document (``--output`` writes it to
@@ -43,7 +47,8 @@ TARGETS = ["dmlc_core_tpu", "tests", "examples", "bench.py",
            "__graft_entry__.py"]
 
 PER_FILE_PASSES = ("lockset", "purity", "resources", "protocol", "transport")
-PROJECT_PASSES = ("deadlock", "contracts", "escape", "jaxbound")
+PROJECT_PASSES = ("deadlock", "contracts", "escape", "jaxbound", "races",
+                  "wiretaint")
 
 # non-library files that still get threading-discipline passes (bench.py
 # spawns watchdog/collector threads; its lock use is production code even
@@ -157,6 +162,24 @@ ALL_RULES = {
         "to a call-only local): the compile cache is always empty, so "
         "every call retraces — store the jitted fn on the instance/"
         "module or memoize its builder"),
+    "race-unlocked-shared-write": (
+        "attribute reachable from a thread-entry root and another thread "
+        "is written with no lock held at any write site (Eraser empty "
+        "lockset) — guard every access with one lock, publish before "
+        "thread start, or hand off via a queue"),
+    "race-inconsistent-lockset": (
+        "shared attribute's write sites hold locks, but no ONE lock is "
+        "held at all of them (empty lockset intersection) — each site "
+        "looks locked in isolation while the writes still race"),
+    "taint-unbounded-wire-int": (
+        "int decoded from the wire (FramedSocket recvint, struct.unpack, "
+        "JSON off a received frame) sizes an allocation, range(), recv(n) "
+        "or sequence repeat without a bounds guard — one hostile frame "
+        "picks the allocation size"),
+    "taint-wire-str-in-path": (
+        "string decoded from the wire reaches open()/os.path.join()/"
+        "Path()/remove() without an allowlist or basename() step — path "
+        "traversal from a protocol frame"),
 }
 
 # which pass owns which rule (drives --pass filtering of stale-entry
@@ -176,6 +199,8 @@ RULES_BY_PASS: Dict[str, Tuple[str, ...]] = {
     "escape": ("escape-leak-on-raise", "escape-double-release"),
     "jaxbound": ("jaxbound-unaccounted-transfer", "jaxbound-wide-wire",
                  "jaxbound-jit-in-hot-path"),
+    "races": ("race-unlocked-shared-write", "race-inconsistent-lockset"),
+    "wiretaint": ("taint-unbounded-wire-int", "taint-wire-str-in-path"),
 }
 
 
@@ -520,6 +545,8 @@ def _run_project_passes(selected: Set[str],
     from dmlc_core_tpu.analysis import deadlock as deadlock_mod
     from dmlc_core_tpu.analysis import escape as escape_mod
     from dmlc_core_tpu.analysis import jaxbound as jaxbound_mod
+    from dmlc_core_tpu.analysis import races as races_mod
+    from dmlc_core_tpu.analysis import wiretaint as wiretaint_mod
     from dmlc_core_tpu.analysis.graph import ProjectGraph
 
     graph = ProjectGraph(contexts)
@@ -533,6 +560,10 @@ def _run_project_passes(selected: Set[str],
         findings += escape_mod.run_project(graph)
     if "jaxbound" in selected:
         findings += jaxbound_mod.run_project(graph)
+    if "races" in selected:
+        findings += races_mod.run_project(graph)
+    if "wiretaint" in selected:
+        findings += wiretaint_mod.run_project(graph)
     supp_by_file: Dict[str, Dict[int, Set[str]]] = {}
     for ctx in contexts:
         supp_by_file[ctx.relpath] = suppressed_lines(ctx.source)
@@ -627,6 +658,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="also write the SARIF document here (works "
                              "with any --format; with --format sarif it "
                              "replaces stdout output)")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="fan the per-file passes out over N worker "
+                             "processes (default: 1 = serial); findings "
+                             "are reassembled in file order, so output "
+                             "is byte-identical to a serial run. Project "
+                             "passes always run sequentially")
     parser.add_argument("--emit-knob-catalog", action="store_true",
                         help="print the generated DMLC_* knob catalog "
                              "markdown table and exit")
@@ -662,6 +699,28 @@ def _selected_passes(args) -> Tuple[Set[str], bool]:
         raise ValueError("--pass given but names no pass (choose from "
                          f"{', '.join(sorted(every))})")
     return out, True
+
+
+def _scan_file_job(job: Tuple[str, Set[str]]) -> Tuple[str, List[Finding]]:
+    """One ``--jobs`` unit of work: read/parse a file and run its per-file
+    passes.  Module-level (not a closure) so process pools can pickle it;
+    Finding is a frozen dataclass of primitives, so results ship back
+    cheaply.  ASTs never cross the process boundary — the project stage
+    re-parses its own contexts."""
+    path, selected = job
+    relpath = repo_relpath(path)
+    source, err = _read_source(path, relpath)
+    if source is None:
+        return relpath, [err]
+    per_file = [p for p in default_passes(relpath) if p in selected]
+    tree, syntax = _parse_tree(source, relpath)
+    if tree is None:
+        return relpath, [syntax]
+    if not per_file:
+        return relpath, []
+    ctx = FileContext(relpath, source, tree, _project_scope(relpath),
+                      cli_exempt=relpath in CLI_EXEMPT)
+    return relpath, _analyze_context(ctx, per_file)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -701,29 +760,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     except FileNotFoundError as exc:
         print(f"dmlclint: {exc}", file=sys.stderr)
         return 2
-    findings: List[Finding] = []
-    parsed: Dict[str, FileContext] = {}
-    for path in files:
-        relpath = repo_relpath(path)
-        source, err = _read_source(path, relpath)
-        if source is None:
-            findings.append(err)
-            continue
-        per_file = [p for p in default_passes(relpath) if p in selected]
-        tree, syntax = _parse_tree(source, relpath)
-        if tree is None:
-            findings.append(syntax)
-            continue
-        if per_file or _project_scope(relpath):
-            # context built once: shared by the per-file passes here and
-            # the project passes below (no re-parse)
-            ctx = FileContext(relpath, source, tree,
-                              _project_scope(relpath),
-                              cli_exempt=relpath in CLI_EXEMPT)
-            findings += _analyze_context(ctx, per_file)
-            if _project_scope(relpath):
-                parsed[relpath] = ctx
-
     # project passes: on by default for the unscoped gate run; a scoped
     # (path-argument) run skips them unless --pass asks — and then the
     # graph is still built over the whole repo, because a partial call
@@ -731,9 +767,55 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     project_selected = selected & set(PROJECT_PASSES)
     project_ran = bool(project_selected
                        and (not args.paths or explicit_passes))
-    if project_ran:
-        contexts = _project_contexts(extra=parsed)
-        findings += _run_project_passes(project_selected, contexts)
+
+    findings: List[Finding] = []
+    project_findings: List[Finding] = []
+    jobs = max(1, args.jobs or 1)
+    if jobs > 1 and len(files) > 1:
+        # fan the per-file stage out.  pool.map submits every file up
+        # front and preserves input order, so the parent can run the
+        # (sequential, graph-bound) project passes WHILE workers chew
+        # the per-file passes, then drain results — same findings, same
+        # order, byte-identical output to a serial run
+        import concurrent.futures
+
+        with concurrent.futures.ProcessPoolExecutor(
+                max_workers=jobs) as pool:
+            per_file_results = pool.map(
+                _scan_file_job, [(p, selected) for p in files],
+                chunksize=max(1, len(files) // (jobs * 4)))
+            if project_ran:
+                project_findings = _run_project_passes(
+                    project_selected, _project_contexts())
+            for _relpath, batch in per_file_results:
+                findings += batch
+    else:
+        parsed: Dict[str, FileContext] = {}
+        for path in files:
+            relpath = repo_relpath(path)
+            source, err = _read_source(path, relpath)
+            if source is None:
+                findings.append(err)
+                continue
+            per_file = [p for p in default_passes(relpath)
+                        if p in selected]
+            tree, syntax = _parse_tree(source, relpath)
+            if tree is None:
+                findings.append(syntax)
+                continue
+            if per_file or _project_scope(relpath):
+                # context built once: shared by the per-file passes here
+                # and the project passes below (no re-parse)
+                ctx = FileContext(relpath, source, tree,
+                                  _project_scope(relpath),
+                                  cli_exempt=relpath in CLI_EXEMPT)
+                findings += _analyze_context(ctx, per_file)
+                if _project_scope(relpath):
+                    parsed[relpath] = ctx
+        if project_ran:
+            project_findings = _run_project_passes(
+                project_selected, _project_contexts(extra=parsed))
+    findings += project_findings
 
     try:
         # --no-baseline only changes *reporting*; a rewrite still loads the

@@ -172,6 +172,11 @@ class ClassInfo:
                         and node.targets[0].value.id == "self"):
                     continue
                 ref = _ctor_ref(node.value)
+                if ref is None and isinstance(node.value, ast.Name):
+                    # `self.registry = registry` with an annotated param
+                    # (`registry: ModelRegistry`): the annotation is the
+                    # ctor the caller ran
+                    ref = method.param_types.get(node.value.id)
                 if ref:
                     self.attr_types.setdefault(node.targets[0].attr, ref)
 

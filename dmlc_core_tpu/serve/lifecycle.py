@@ -109,6 +109,10 @@ class CheckpointWatcher:
         self.poll_s = poll_s if poll_s is not None \
             else watch_interval_from_env()
         CHECK(self.poll_s > 0, "poll_s must be > 0")
+        # guards the progress odometers and the known-bad set: poll_once
+        # is public API (tests/operators drive it inline) and also runs
+        # on the watcher thread, so these are written from both sides
+        self._lock = threading.Lock()
         self.swaps_completed = 0
         #: candidates rejected (validation/warmup/swap failures) — with
         #: ``swaps_completed``, the watcher's public progress odometer
@@ -207,7 +211,8 @@ class CheckpointWatcher:
         except Exception as exc:
             self._reject(step, manifest, stage, exc, slot)
             return None
-        self.swaps_completed += 1
+        with self._lock:
+            self.swaps_completed += 1
         telemetry.count("dmlc_serve_swap_total", model=self.model,
                         outcome="ok")
         telemetry.observe("dmlc_serve_swap_seconds",
@@ -238,20 +243,23 @@ class CheckpointWatcher:
                 # flight on a store without atomic rename — do not even
                 # open it, and do not skip past it
                 return None, None
-            if (step, manifest.get("crc32")) in self._rejected:
+            with self._lock:
+                known_bad = (step, manifest.get("crc32")) in self._rejected
+            if known_bad:
                 continue  # known-bad bytes: fall back to the next-newest
             return step, manifest
         return None, None
 
     def _reject(self, step, manifest, stage: str, exc: Exception,
                 slot) -> None:
-        self.rejections += 1
+        with self._lock:
+            self.rejections += 1
+            if step is not None and manifest is not None:
+                self._rejected.add((step, manifest.get("crc32")))
         telemetry.count("dmlc_serve_swap_total", model=self.model,
                         outcome="failed")
         telemetry.count("dmlc_serve_swap_failures_total", model=self.model,
                         stage=stage)
-        if step is not None and manifest is not None:
-            self._rejected.add((step, manifest.get("crc32")))
         log_warning(
             f"serve: model {self.model!r} candidate "
             f"{'step ' + str(step) if step is not None else 'scan'} "

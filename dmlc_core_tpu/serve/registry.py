@@ -155,13 +155,18 @@ class ModelRegistry:
         "previous-good keeps serving"."""
         slot = self.get(name)
         old_version = slot.version
-        # stamp BEFORE the flip: no batch can snapshot the new runtime
-        # without its version riding along
-        runtime.version = version
-        slot.batcher.set_runtime(runtime)  # the atomic pointer flip
-        slot.version = version
-        slot.warmed = True
-        slot.swapped_at = clock.monotonic()
+        # the flip and the stamps happen under the registry lock: the
+        # watcher thread swaps while the main thread reads describe()/
+        # get(), and a torn version/warmed/swapped_at trio would report
+        # a half-swapped slot
+        with self._lock:
+            # stamp BEFORE the flip: no batch can snapshot the new
+            # runtime without its version riding along
+            runtime.version = version
+            slot.batcher.set_runtime(runtime)  # the atomic pointer flip
+            slot.version = version
+            slot.warmed = True
+            slot.swapped_at = clock.monotonic()
         telemetry.gauge_set("dmlc_serve_swap_version", float(version),
                             model=name)
         log_info(f"serve: model {name!r} swapped "

@@ -199,9 +199,13 @@ class MicroBatcher:
 
     def _drain_failed(self, exc: Exception, *, reason: str) -> None:
         pending = []
-        if self._carry is not None:
-            pending.append(self._carry)
-            self._carry = None
+        # close() drains from the caller's thread while the batcher loop
+        # may still be parked in _assemble: the carry swap must hold the
+        # same (reentrant) lock _assemble uses
+        with self._thread_lock:
+            if self._carry is not None:
+                pending.append(self._carry)
+                self._carry = None
         while True:
             try:
                 pending.append(self._queue.get_nowait())
@@ -325,9 +329,9 @@ class MicroBatcher:
         """
         batch: List[_Pending] = []
         try:
-            if self._carry is not None:
+            with self._thread_lock:
                 first, self._carry = self._carry, None
-            else:
+            if first is None:
                 try:
                     if wait:
                         first = self._queue.get(timeout=0.05)
@@ -349,7 +353,8 @@ class MicroBatcher:
                 except queue.Empty:
                     break
                 if rows + item.rows.shape[0] > self.max_batch:
-                    self._carry = item
+                    with self._thread_lock:
+                        self._carry = item
                     break
                 batch.append(item)
                 rows += item.rows.shape[0]

@@ -882,8 +882,11 @@ class ShardLeaseCoordinator:
                 self._serve_inner()
         except Exception as exc:  # noqa: BLE001 — ferried to result()
             logger.exception("shard-lease serve loop died")
-            self.error = (f"shard-lease serve loop died: "
-                          f"{type(exc).__name__}: {exc}")
+            # result() polls error from the caller's thread (no join):
+            # the crash report rides the same lock as the ledger
+            with self._lock:
+                self.error = (f"shard-lease serve loop died: "
+                              f"{type(exc).__name__}: {exc}")
         finally:
             try:
                 self.sock.close()
@@ -1094,15 +1097,16 @@ class ShardLeaseCoordinator:
         failed workers — a degraded ingest must never read as a clean one)."""
         deadline = None if timeout is None else time.time() + timeout
         while True:
-            if self.error:
-                raise TrackerError(self.error)
             with self._lock:
+                error = self.error
                 missing = [i for i, u in enumerate(self._units)
                            if u["status"] != self.COMMITTED]
                 # snapshot under the lock: the serve thread pops entries
                 # when a failed worker comes back, and a raced read here
                 # would trade the coverage diagnostic for a KeyError
                 failed = dict(self.failed_workers)
+            if error:
+                raise TrackerError(error)
             if not missing:
                 return self.ledger()
             if deadline is not None and time.time() > deadline:

@@ -3175,3 +3175,740 @@ def test_escape_os_close_in_finally_clean_twin():
                     os.close(fd)
         """,
     }) == []
+
+
+# -- pass 10: races -----------------------------------------------------------
+
+def _races_findings(files):
+    from dmlc_core_tpu.analysis import races
+
+    return races.run_project(_graph(files))
+
+
+def _races_on_sources(sources):
+    import ast as _ast
+
+    from dmlc_core_tpu.analysis import races
+    from dmlc_core_tpu.analysis.driver import FileContext
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    ctxs = [FileContext(rel, src, _ast.parse(src), True, False)
+            for rel, src in sources.items()]
+    return races.run_project(ProjectGraph(ctxs))
+
+
+def test_race_unlocked_shared_write_trips():
+    found = _races_findings({
+        "dmlc_core_tpu/r.py": """
+            import threading
+
+            class Meter:
+                def __init__(self):
+                    self.count = 0
+                    self._thread = None
+
+                def start(self):
+                    self._thread = threading.Thread(target=self._loop)
+                    self._thread.start()
+
+                def _loop(self):
+                    while True:
+                        self.count += 1
+
+                def bump(self):
+                    self.count += 1
+        """,
+    })
+    assert [f.rule for f in found] == ["race-unlocked-shared-write"]
+    assert found[0].symbol == "Meter.count"
+    # anchored at a write site, thread-side preferred (the _loop body)
+    assert found[0].lineno == 15
+
+
+def test_race_consistent_lock_is_clean():
+    assert _races_findings({
+        "dmlc_core_tpu/r.py": """
+            import threading
+
+            class Meter:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self.count += 1
+
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+        """,
+    }) == []
+
+
+def test_race_inconsistent_lockset_trips():
+    found = _races_findings({
+        "dmlc_core_tpu/r.py": """
+            import threading
+
+            class Meter:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    while True:
+                        with self._lock:
+                            self.count += 1
+
+                def bump(self):
+                    self.count += 1
+        """,
+    })
+    assert [f.rule for f in found] == ["race-inconsistent-lockset"]
+    assert found[0].symbol == "Meter.count"
+
+
+def test_race_init_before_start_publication_is_clean():
+    # Eraser's initialization exemption: writes before the thread exists
+    # cannot race with it
+    assert _races_findings({
+        "dmlc_core_tpu/r.py": """
+            import threading
+
+            class Once:
+                def launch(self):
+                    self.total = 0
+                    t = threading.Thread(target=self._loop)
+                    self.total = 5
+                    t.start()
+
+                def _loop(self):
+                    return self.total
+        """,
+    }) == []
+
+
+def test_race_queue_handoff_is_clean():
+    # sync-typed attributes (Queue) mediate their own handoff
+    assert _races_findings({
+        "dmlc_core_tpu/r.py": """
+            import queue
+            import threading
+
+            class Pipe:
+                def __init__(self):
+                    self.q = queue.Queue()
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.q.put(1)
+
+                def take(self):
+                    return self.q.get()
+        """,
+    }) == []
+
+
+def test_race_join_mediated_read_is_clean():
+    assert _races_findings({
+        "dmlc_core_tpu/r.py": """
+            import threading
+
+            class Job:
+                def __init__(self):
+                    self.result = None
+                    self._t = None
+
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    self.result = 42
+
+                def wait(self):
+                    self._t.join()
+                    return self.result
+        """,
+    }) == []
+
+
+def test_race_entry_held_lock_propagates_into_helper():
+    # the _locked-helper idiom: the helper's writes inherit the lock every
+    # caller demonstrably holds at the call site
+    assert _races_findings({
+        "dmlc_core_tpu/r.py": """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def bump(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def _bump_locked(self):
+                    self.n += 1
+        """,
+    }) == []
+
+
+def test_race_http_handler_method_is_a_thread_root():
+    found = _races_findings({
+        "dmlc_core_tpu/r.py": """
+            from http.server import BaseHTTPRequestHandler
+
+            class Stats:
+                def __init__(self):
+                    self.hits = 0
+
+            def record(stats: Stats):
+                stats.hits += 1
+
+            def reset(stats: Stats):
+                stats.hits = 0
+
+            class Handler(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    record(self.stats)
+        """,
+    })
+    assert [f.rule for f in found] == ["race-unlocked-shared-write"]
+    assert found[0].symbol == "Stats.hits"
+
+
+def test_race_handler_own_attrs_are_per_request():
+    # handler instances are per-request: their own attributes never shared
+    assert _races_findings({
+        "dmlc_core_tpu/r.py": """
+            from http.server import BaseHTTPRequestHandler
+
+            class Handler(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    self.replied = True
+
+                def do_POST(self):
+                    self.replied = False
+        """,
+    }) == []
+
+
+def test_race_fresh_local_construction_is_clean():
+    # the URI.copy shape: writes to an object this function just built
+    # are pre-publication by construction
+    assert _races_findings({
+        "dmlc_core_tpu/box.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self.val = 0
+
+                def copy(self):
+                    out = Box()
+                    out.val = self.val
+                    return out
+
+            class Runner:
+                def __init__(self, box: Box):
+                    self.box = box
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.box.copy()
+        """,
+    }) == []
+
+
+def test_race_thread_confined_class_is_clean():
+    # every known construction site is thread-side: the instance never
+    # crosses to the main side even though its methods are public-named
+    assert _races_findings({
+        "dmlc_core_tpu/r.py": """
+            import threading
+
+            class Entry:
+                def __init__(self):
+                    self.rank = -1
+
+                def assign(self, r):
+                    self.rank = r
+
+            class Pool:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    e = Entry()
+                    e.assign(3)
+        """,
+    }) == []
+
+
+def test_race_cross_module_finding_anchors_at_write_site():
+    """The finding lands on the racy WRITE (file + line), not on the
+    thread-entry point in the spawning module."""
+    found = _races_on_sources({
+        "dmlc_core_tpu/w.py": textwrap.dedent("""\
+            import threading
+
+            from dmlc_core_tpu.s import Store
+
+            class Watcher:
+                def __init__(self, store: Store):
+                    self.store = store
+
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self.store.flip()
+        """),
+        "dmlc_core_tpu/s.py": textwrap.dedent("""\
+            class Store:
+                def __init__(self):
+                    self.version = 0
+
+                def flip(self):
+                    self.version += 1
+
+                def publish(self):
+                    self.version = 7
+        """),
+    })
+    assert [f.rule for f in found] == ["race-unlocked-shared-write"]
+    assert found[0].symbol == "Store.version"
+    assert found[0].path == "dmlc_core_tpu/s.py"
+    assert found[0].lineno == 6  # `self.version += 1` in flip
+
+
+def test_race_suppression_works_like_any_project_rule():
+    from dmlc_core_tpu.analysis.driver import _run_project_passes
+
+    src = textwrap.dedent("""
+        import threading
+
+        class Meter:
+            def __init__(self):
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                # benign: approximate odometer, torn reads acceptable
+                # dmlclint: disable=race-unlocked-shared-write
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+    """)
+    import ast as _ast
+    from dmlc_core_tpu.analysis.driver import FileContext
+
+    ctx = FileContext("dmlc_core_tpu/r.py", src, _ast.parse(src), True,
+                      False)
+    assert _run_project_passes({"races"}, [ctx]) == []
+
+
+# -- pass 10: seeded race twins against the REAL files ------------------------
+
+def test_seeded_unlocked_carry_in_real_scheduler():
+    """Re-introducing the unlocked MicroBatcher._carry handoff (writes
+    from close()'s caller thread racing the batcher loop's _assemble)
+    produces exactly ONE finding with the right rule id."""
+    src = _real_source("dmlc_core_tpu/serve/scheduler.py")
+    broken = src.replace(
+        "        with self._thread_lock:\n"
+        "            if self._carry is not None:\n"
+        "                pending.append(self._carry)\n"
+        "                self._carry = None",
+        "        if self._carry is not None:\n"
+        "            pending.append(self._carry)\n"
+        "            self._carry = None")
+    broken2 = broken.replace(
+        "            with self._thread_lock:\n"
+        "                first, self._carry = self._carry, None",
+        "            first, self._carry = self._carry, None")
+    broken3 = broken2.replace(
+        "                    with self._thread_lock:\n"
+        "                        self._carry = item",
+        "                    self._carry = item")
+    for a, b in ((src, broken), (broken, broken2), (broken2, broken3)):
+        assert a != b, "fix shape changed; update the seeding"
+    found = _races_on_sources(
+        {"dmlc_core_tpu/serve/scheduler.py": broken3})
+    assert len(found) == 1
+    assert found[0].rule == "race-unlocked-shared-write"
+    assert found[0].symbol == "MicroBatcher._carry"
+
+
+def test_real_scheduler_is_race_clean():
+    src = _real_source("dmlc_core_tpu/serve/scheduler.py")
+    assert _races_on_sources(
+        {"dmlc_core_tpu/serve/scheduler.py": src}) == []
+
+
+def test_seeded_unlocked_odometer_in_real_lifecycle():
+    """Regression for the fixed CheckpointWatcher.swaps_completed race:
+    poll_once bumps the odometer from both the watcher thread and
+    inline callers."""
+    src = _real_source("dmlc_core_tpu/serve/lifecycle.py")
+    broken = src.replace(
+        "        with self._lock:\n"
+        "            self.swaps_completed += 1",
+        "        self.swaps_completed += 1")
+    assert broken != src, "fix shape changed; update the seeding"
+    found = _races_on_sources({"dmlc_core_tpu/serve/lifecycle.py": broken})
+    assert [(f.rule, f.symbol) for f in found] == \
+        [("race-unlocked-shared-write", "CheckpointWatcher.swaps_completed")]
+
+
+def test_seeded_unlocked_reject_ledger_in_real_lifecycle():
+    """Regression for the fixed rejections/_rejected races: _reject is
+    the only writer of both, so stripping its lock degrades both the
+    odometer and the known-bad ledger to unlocked shared writes (the
+    lockset discipline is computed over writes; _candidate's locked
+    read does not resurrect it)."""
+    src = _real_source("dmlc_core_tpu/serve/lifecycle.py")
+    broken = src.replace(
+        "        with self._lock:\n"
+        "            self.rejections += 1\n"
+        "            if step is not None and manifest is not None:\n"
+        "                self._rejected.add((step, manifest.get(\"crc32\")))",
+        "        self.rejections += 1\n"
+        "        if step is not None and manifest is not None:\n"
+        "            self._rejected.add((step, manifest.get(\"crc32\")))")
+    assert broken != src, "fix shape changed; update the seeding"
+    found = _races_on_sources({"dmlc_core_tpu/serve/lifecycle.py": broken})
+    got = {(f.rule, f.symbol) for f in found}
+    assert ("race-unlocked-shared-write",
+            "CheckpointWatcher.rejections") in got
+    assert ("race-unlocked-shared-write",
+            "CheckpointWatcher._rejected") in got
+
+
+def test_real_lifecycle_is_race_clean():
+    src = _real_source("dmlc_core_tpu/serve/lifecycle.py")
+    assert _races_on_sources(
+        {"dmlc_core_tpu/serve/lifecycle.py": src}) == []
+
+
+def test_seeded_unlocked_swap_in_real_registry():
+    """Regression for the fixed ModelRegistry.swap races: the version/
+    warmed/swapped_at stamps (and the runtime's version ride-along)
+    used to happen outside the registry lock while the watcher thread
+    swapped against main-thread describe()/get() readers."""
+    reg = _real_source("dmlc_core_tpu/serve/registry.py")
+    life = _real_source("dmlc_core_tpu/serve/lifecycle.py")
+    broken = reg.replace(
+        "        with self._lock:\n"
+        "            # stamp BEFORE the flip: no batch can snapshot the new\n"
+        "            # runtime without its version riding along\n"
+        "            runtime.version = version\n"
+        "            slot.batcher.set_runtime(runtime)"
+        "  # the atomic pointer flip\n"
+        "            slot.version = version\n"
+        "            slot.warmed = True\n"
+        "            slot.swapped_at = clock.monotonic()",
+        "        runtime.version = version\n"
+        "        slot.batcher.set_runtime(runtime)\n"
+        "        slot.version = version\n"
+        "        slot.warmed = True\n"
+        "        slot.swapped_at = clock.monotonic()")
+    assert broken != reg, "fix shape changed; update the seeding"
+    found = _races_on_sources({
+        "dmlc_core_tpu/serve/registry.py": broken,
+        "dmlc_core_tpu/serve/lifecycle.py": life,
+        "dmlc_core_tpu/serve/model_runtime.py":
+            _real_source("dmlc_core_tpu/serve/model_runtime.py"),
+    })
+    got = {f.symbol for f in found}
+    assert {"ModelRuntime.version", "ModelSlot.version",
+            "ModelSlot.warmed", "ModelSlot.swapped_at"} <= got
+    assert all(f.rule == "race-unlocked-shared-write" for f in found)
+
+
+def test_real_registry_is_race_clean():
+    found = _races_on_sources({
+        "dmlc_core_tpu/serve/registry.py":
+            _real_source("dmlc_core_tpu/serve/registry.py"),
+        "dmlc_core_tpu/serve/lifecycle.py":
+            _real_source("dmlc_core_tpu/serve/lifecycle.py"),
+        "dmlc_core_tpu/serve/model_runtime.py":
+            _real_source("dmlc_core_tpu/serve/model_runtime.py"),
+    })
+    assert found == []
+
+
+def test_seeded_unlocked_error_ferry_in_real_rendezvous():
+    """Regression for the fixed ShardLeaseCoordinator.error race: the
+    serve loop's crash report must ride the ledger lock, because
+    result() polls it from the caller's thread with no join barrier."""
+    src = _real_source("dmlc_core_tpu/tracker/rendezvous.py")
+    broken = src.replace(
+        "            # result() polls error from the caller's thread"
+        " (no join):\n"
+        "            # the crash report rides the same lock as the ledger\n"
+        "            with self._lock:\n"
+        "                self.error = (f\"shard-lease serve loop died: \"\n"
+        "                              f\"{type(exc).__name__}: {exc}\")",
+        "            self.error = (f\"shard-lease serve loop died: \"\n"
+        "                          f\"{type(exc).__name__}: {exc}\")")
+    assert broken != src, "fix shape changed; update the seeding"
+    found = _races_on_sources(
+        {"dmlc_core_tpu/tracker/rendezvous.py": broken})
+    assert [(f.rule, f.symbol) for f in found] == \
+        [("race-unlocked-shared-write", "ShardLeaseCoordinator.error")]
+
+
+def test_real_rendezvous_is_race_clean():
+    src = _real_source("dmlc_core_tpu/tracker/rendezvous.py")
+    assert _races_on_sources(
+        {"dmlc_core_tpu/tracker/rendezvous.py": src}) == []
+
+
+# -- pass 11: wiretaint -------------------------------------------------------
+
+def _wiretaint_findings(files):
+    from dmlc_core_tpu.analysis import wiretaint
+
+    return wiretaint.run_project(_graph(files))
+
+
+def _wiretaint_on_source(relpath, src):
+    import ast as _ast
+
+    from dmlc_core_tpu.analysis import wiretaint
+    from dmlc_core_tpu.analysis.driver import FileContext
+    from dmlc_core_tpu.analysis.graph import ProjectGraph
+
+    ctx = FileContext(relpath, src, _ast.parse(src), True, False)
+    return wiretaint.run_project(ProjectGraph([ctx]))
+
+
+def test_taint_recvint_into_recvall_trips():
+    found = _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            def read_blob(sock):
+                n = sock.recvint()
+                return sock.recvall(n)
+        """,
+    })
+    assert [f.rule for f in found] == ["taint-unbounded-wire-int"]
+    assert found[0].symbol == "read_blob"
+    assert found[0].lineno == 4  # anchored at the sink
+
+
+def test_taint_bounds_guard_clears():
+    assert _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            def read_blob(sock):
+                n = sock.recvint()
+                if n < 0 or n > 1048576:
+                    raise ValueError(n)
+                return sock.recvall(n)
+        """,
+    }) == []
+
+
+def test_taint_range_sink_trips():
+    found = _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            def read_rows(sock):
+                k = sock.recvint()
+                out = []
+                for _ in range(k):
+                    out.append(sock.recvstr())
+                return out
+        """,
+    })
+    assert [f.rule for f in found] == ["taint-unbounded-wire-int"]
+
+
+def test_taint_min_bound_is_clean():
+    assert _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            def read_rows(sock):
+                k = min(sock.recvint(), 64)
+                out = []
+                for _ in range(k):
+                    out.append(sock.recvstr())
+                return out
+        """,
+    }) == []
+
+
+def test_taint_list_multiply_trips():
+    found = _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            def prealloc(sock):
+                n = sock.recvint()
+                return [None] * n
+        """,
+    })
+    assert [f.rule for f in found] == ["taint-unbounded-wire-int"]
+
+
+def test_taint_wire_str_into_path_trips():
+    found = _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            def fetch(sock):
+                name = sock.recvstr()
+                return open(name, "rb")
+        """,
+    })
+    assert [f.rule for f in found] == ["taint-wire-str-in-path"]
+    assert found[0].symbol == "fetch"
+
+
+def test_taint_basename_sanitizes_path():
+    assert _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            import os
+
+            def fetch(sock, root):
+                name = os.path.basename(sock.recvstr())
+                return open(os.path.join(root, name), "rb")
+        """,
+    }) == []
+
+
+def test_taint_params_are_trusted():
+    # function-local analysis: parameters are the caller's problem (the
+    # documented soundness boundary)
+    assert _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            def alloc(n):
+                return bytearray(n)
+        """,
+    }) == []
+
+
+def test_taint_two_sinks_get_distinct_instance_keys(tmp_path):
+    """Two sinks in one function share (file, rule, symbol): the
+    baseline must key them apart (`key` and `key#2`) so fixing one does
+    not silently absorb the other."""
+    found = _wiretaint_findings({
+        "dmlc_core_tpu/t.py": """
+            def read_two(sock):
+                a = sock.recvint()
+                b = sock.recvint()
+                x = bytearray(a)
+                y = bytearray(b)
+                return x, y
+        """,
+    })
+    assert [f.rule for f in found] == ["taint-unbounded-wire-int"] * 2
+    assert found[0].key == found[1].key  # raw keys collide...
+    bl = str(tmp_path / "baseline.json")
+    baseline_mod.save(bl, found, {})
+    keys = set(baseline_mod.load(bl))
+    assert keys == {found[0].key, f"{found[0].key}#2"}  # ...instances don't
+
+
+def test_taint_suppression_works_like_any_project_rule():
+    from dmlc_core_tpu.analysis.driver import _run_project_passes
+
+    src = textwrap.dedent("""
+        def read_blob(sock):
+            n = sock.recvint()
+            # peer is mutually authenticated; size audited upstream
+            # dmlclint: disable=taint-unbounded-wire-int
+            return sock.recvall(n)
+    """)
+    import ast as _ast
+    from dmlc_core_tpu.analysis.driver import FileContext
+
+    ctx = FileContext("dmlc_core_tpu/t.py", src, _ast.parse(src), True,
+                      False)
+    assert _run_project_passes({"wiretaint"}, [ctx]) == []
+
+
+def test_seeded_unbounded_wire_int_in_real_rendezvous():
+    """Stripping FramedSocket.recvstr's MAX_FRAME bounds check feeds a
+    raw wire integer straight into recvall's allocation — exactly ONE
+    finding with the right rule id."""
+    src = _real_source("dmlc_core_tpu/tracker/rendezvous.py")
+    broken = src.replace(
+        "        if n < 0 or n > MAX_FRAME:\n"
+        "            raise ProtocolError(\n"
+        "                f\"invalid string length {n} on the wire"
+        " (bounds [0, \"\n"
+        "                f\"{MAX_FRAME}])\")\n"
+        "        data = self.recvall(n)",
+        "        data = self.recvall(n)")
+    assert broken != src, "fix shape changed; update the seeding"
+    found = _wiretaint_on_source("dmlc_core_tpu/tracker/rendezvous.py",
+                                 broken)
+    assert len(found) == 1
+    assert found[0].rule == "taint-unbounded-wire-int"
+    assert found[0].symbol == "FramedSocket.recvstr"
+
+
+def test_real_rendezvous_is_taint_clean():
+    src = _real_source("dmlc_core_tpu/tracker/rendezvous.py")
+    assert _wiretaint_on_source("dmlc_core_tpu/tracker/rendezvous.py",
+                                src) == []
+
+
+# -- passes 10/11: CLI + parallel driver --------------------------------------
+
+def test_cli_list_rules_has_pass10_and_11(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("race-unlocked-shared-write", "race-inconsistent-lockset",
+                 "taint-unbounded-wire-int", "taint-wire-str-in-path"):
+        assert rule in out
+
+
+@pytest.mark.slow
+def test_cli_pass_races_wiretaint_standalone():
+    """`--pass races,wiretaint` runs repo-wide and exits 0 on the
+    committed tree (the CI race/taint step).
+
+    slow: whole-repo analyzer subprocess; the full gate stays tier-1 via
+    test_repo_is_clean_under_committed_baseline."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.analysis",
+         "--pass", "races,wiretaint"],
+        cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_jobs_output_matches_serial(tmp_path, capsys):
+    """`--jobs 2` must produce byte-identical output to the serial
+    driver: per-file results drain in input order, project passes append
+    after, whatever the workers' completion order."""
+    pkg = tmp_path / "dmlc_core_tpu"
+    pkg.mkdir()
+    for name in ("a.py", "b.py", "c.py"):
+        (pkg / name).write_text("print('oops')\n")
+    bl = str(tmp_path / "baseline.json")
+    rc_serial = main([str(pkg), "--baseline", bl])
+    serial = capsys.readouterr().out
+    rc_jobs = main([str(pkg), "--baseline", bl, "--jobs", "2"])
+    parallel = capsys.readouterr().out
+    assert rc_serial == rc_jobs == 1
+    assert parallel == serial
