@@ -30,6 +30,26 @@ predictions match the model version it names: no request ever saw a
 half-swapped model), at least ``--swaps - 1`` swaps completed, and
 previous-good kept serving across the rejected candidate.  The report
 carries a before/during-swaps latency table.
+
+    python benchmarks/bench_serving.py continuous [--out continuous.json]
+        [--fault-plan benchmarks/continuous_fault_plan.json | none]
+        [--files 14] [--qps 40] [--duration 75]
+
+``continuous`` is the whole-ring chaos drill (docs/training.md): a REAL
+trainer daemon subprocess (``python -m dmlc_core_tpu.train``) consumes a
+spool whose label distribution shifts over time, publishing GBDT
+checkpoints a watched serving slot hot-swaps under open-loop load.  The
+committed plan kills the trainer mid-round (the supervisor relaunches it
+and asserts it resumed from the last valid manifest), tears one publish
+mid-blob (the trainer's own verify must reject it and re-publish the
+same step), and storms the server with injected 503s mid-swap; one spool
+file is poisoned (all-NaN features) and must be quarantined, not fatal.
+Every 200's predictions are re-scored against a reference runtime built
+from the exact checkpoint version the response names (``invalid`` on any
+mismatch), and the gate demands ``crashed == 0``, ``invalid == 0``,
+>= 2 completed swaps, >= 1 kill survived with correct resume provenance,
+>= 1 rejected publish, >= 1 quarantined batch, and the scoring-drift
+canary rising with the shifted distribution.
 """
 
 import argparse
@@ -44,6 +64,8 @@ DEFAULT_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "serving_fault_plan.json")
 LIFECYCLE_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "lifecycle_fault_plan.json")
+CONTINUOUS_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "continuous_fault_plan.json")
 NUM_FEATURE = 16
 
 
@@ -200,7 +222,7 @@ def run_lifecycle(args) -> int:
         mgr.save(step, {"w": np.zeros(NUM_FEATURE, np.float32),
                         "b": np.float32(_bias_for(step))}, async_=False)
 
-    def check(payload):
+    def check(payload, rows=None):
         v = payload.get("version")
         if not isinstance(v, int):
             return False
@@ -318,6 +340,309 @@ def run_lifecycle(args) -> int:
     return 0 if not failures else 1
 
 
+def run_continuous(args) -> int:
+    import subprocess
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from dmlc_core_tpu import fault, telemetry
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+    from dmlc_core_tpu.serve import (CheckpointWatcher, ModelRegistry,
+                                     ScoringServer, build_runtime,
+                                     runtime_builder)
+    from dmlc_core_tpu.serve.loadgen import run_load
+    from dmlc_core_tpu.train.source import DONE_SENTINEL
+
+    telemetry.enable()
+    plan_path = args.fault_plan
+    plan_active = plan_path.lower() != "none"
+    if plan_active:
+        # the driver loads the same committed plan the trainer subprocess
+        # gets via DMLC_FAULT_PLAN: serve.* rules fire here, train.* rules
+        # fire in the daemon — one plan file describes the whole drill
+        with open(plan_path, encoding="utf-8") as f:
+            fault.configure(f.read())
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spool = tempfile.mkdtemp(prefix="continuous-spool-")
+    ckpt = tempfile.mkdtemp(prefix="continuous-ckpt-")
+    mgr = CheckpointManager(ckpt, keep=args.files)
+    rng = np.random.default_rng(5)
+    n_files = args.files
+    poison_index = 7 if n_files > 8 else n_files // 2
+
+    def label_rate(i: int) -> float:
+        # the distribution shift the drift canary must track
+        return 0.12 + (0.88 - 0.12) * i / max(1, n_files - 1)
+
+    def write_spool_file(i: int) -> None:
+        name = f"part-{i:04d}.libsvm"
+        tmp = os.path.join(spool, f".tmp-{name}")
+        with open(tmp, "w", encoding="utf-8") as f:
+            for _ in range(200):
+                if i == poison_index:
+                    feats = " ".join(f"{j}:nan" for j in range(NUM_FEATURE))
+                    f.write(f"0 {feats}\n")
+                    continue
+                x = rng.normal(size=NUM_FEATURE)
+                y = int(rng.random() < label_rate(i))
+                feats = " ".join(f"{j}:{x[j]:.5f}"
+                                 for j in range(NUM_FEATURE))
+                f.write(f"{y} {feats}\n")
+        # atomic rename: the daemon's DirectorySource must never parse a
+        # half-written spool file (".tmp-*" names are skipped by contract)
+        os.replace(tmp, os.path.join(spool, name))
+
+    # the serving side, filled in once the first checkpoint lands; the
+    # spool writer paces itself on it so the ring stays coupled on any
+    # machine speed (the lifecycle-campaign pacing pattern)
+    serving = {"registry": None, "watcher": None}
+
+    def progress() -> int:
+        # serving version once the slot exists, else the newest published
+        # step — so pacing works during bootstrap too
+        registry = serving["registry"]
+        if registry is not None:
+            return registry.get("champion").version
+        step, _ = mgr.latest_valid()
+        return step or 0
+
+    def writer() -> None:
+        for i in range(n_files):
+            write_spool_file(i)
+            if i % 2 == 1:
+                # each file pair funds one publish (4 rounds): hold the
+                # next pair until the ring absorbed this one, so the
+                # drift canary sees the shift arrive — bounded wait, a
+                # killed trainer must not wedge the spool
+                v0 = progress()
+                deadline = time.monotonic() + 10
+                while (progress() <= v0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.1)
+        open(os.path.join(spool, DONE_SENTINEL), "w").close()
+
+    incarnations = []
+
+    def launch_trainer(inc: int):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH",
+                                                             "")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if plan_active:
+            env["DMLC_FAULT_PLAN"] = "@" + os.path.abspath(plan_path)
+        state_path = os.path.join(ckpt, f"state-{inc}.json")
+        cmd = [sys.executable, "-m", "dmlc_core_tpu.train",
+               "--data", spool, "--ckpt", ckpt,
+               "--num-feature", str(NUM_FEATURE),
+               "--rounds-per-batch", "2", "--publish-every-rounds", "4",
+               "--poll-s", "0.1", "--keep", str(args.files),
+               "--learning-rate", "0.3", "--max-depth", "3",
+               "--num-bins", "32", "--exit-when-idle",
+               "--incarnation", str(inc), "--state-file", state_path]
+        proc = subprocess.run(cmd, cwd=repo_root, env=env,
+                              capture_output=True, text=True, timeout=600)
+        state = None
+        if os.path.exists(state_path):
+            with open(state_path, encoding="utf-8") as f:
+                state = json.load(f)
+        return proc.returncode, state, proc.stderr[-2000:]
+
+    def supervise() -> None:
+        inc = 1
+        while inc <= 5:
+            # snapshot what a correct resume must restore BEFORE the
+            # relaunch — the provenance the gate checks
+            expect = None
+            if inc > 1:
+                expect, _ = mgr.latest_valid(verify=True,
+                                             skip_unpublished=True)
+            rc, state, stderr = launch_trainer(inc)
+            incarnations.append({"incarnation": inc, "rc": rc,
+                                 "expected_resume": expect,
+                                 "state": state, "stderr_tail": stderr})
+            print(f"trainer incarnation {inc} exited rc={rc} "
+                  f"state={state}")
+            if rc != 43:  # 43 = the plan's injected mid-round kill
+                return
+            inc += 1
+
+    threading.Thread(target=writer, daemon=True).start()
+    sup = threading.Thread(target=supervise)
+    sup.start()
+
+    # bootstrap: wait for the daemon's first valid manifest, then serve it
+    deadline = time.monotonic() + 240
+    first_step = None
+    while time.monotonic() < deadline:
+        first_step, _ = mgr.latest_valid(verify=True)
+        if first_step is not None:
+            break
+        time.sleep(0.2)
+    report = {"fault_plan": plan_path if plan_active else None,
+              "host": _host_info(), "files": n_files,
+              "poison_index": poison_index, "checkpoint_dir": ckpt}
+    if first_step is None:
+        sup.join(60)
+        report["slo_ok"] = False
+        report["slo_failures"] = ["trainer never published a valid "
+                                  "checkpoint"]
+        report["incarnations"] = incarnations
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return 1
+
+    registry = ModelRegistry()
+    registry.add("champion",
+                 build_runtime("gbdt", NUM_FEATURE,
+                               checkpoint=mgr.step_uri(first_step)),
+                 version=first_step, max_batch=32, max_delay_ms=2.0,
+                 default=True)
+
+    # reference check: rebuild THE version each 200 names from its own
+    # checkpoint and re-score this request's rows — any mismatch is a
+    # response served by a model other than the one it claims (invalid)
+    ref_lock = threading.Lock()
+    ref_runtimes = {}
+
+    def check(payload, rows=None):
+        v = payload.get("version")
+        if not isinstance(v, int) or rows is None:
+            return False
+        with ref_lock:
+            rt = ref_runtimes.get(v)
+            if rt is None:
+                try:
+                    rt = build_runtime("gbdt", NUM_FEATURE,
+                                       checkpoint=mgr.step_uri(v))
+                except Exception:
+                    return False  # a version that is not in the store
+                ref_runtimes[v] = rt
+            want = np.asarray(
+                rt.predict(np.asarray(rows, np.float32))).reshape(-1)
+        got = np.asarray(payload["predictions"], np.float64).reshape(-1)
+        return got.shape == want.shape \
+            and bool(np.allclose(got, want, atol=1e-4))
+
+    with ScoringServer(registry, request_timeout_s=8.0) as server:
+        watcher = CheckpointWatcher(registry, "champion", ckpt,
+                                    runtime_builder("gbdt", NUM_FEATURE),
+                                    poll_s=0.25, manager=mgr)
+        with watcher:
+            serving["registry"] = registry
+            serving["watcher"] = watcher
+            report["load"] = run_load(
+                server.url, qps=args.qps, duration_s=args.duration,
+                num_feature=NUM_FEATURE, rows_per_request=2, seed=13,
+                timeout_s=8.0, model="champion", response_check=check)
+            sup.join(300)
+            # let the watcher absorb whatever the last incarnation
+            # published after the load window closed
+            last_step, _ = mgr.latest_valid()
+            deadline = time.monotonic() + 30
+            while (registry.get("champion").version < (last_step or 0)
+                   and time.monotonic() < deadline):
+                time.sleep(0.2)
+            report["swaps_completed"] = watcher.swaps_completed
+            report["watcher_rejections"] = watcher.rejections
+            report["final_version"] = registry.get("champion").version
+    report["last_step"] = last_step
+    report["incarnations"] = [
+        {k: v for k, v in inc.items() if k != "stderr_tail"}
+        for inc in incarnations]
+    fired = [(site, kind) for site, kind, _ in fault.fires()]
+    report["faults_fired"] = sorted(set(fired))
+
+    kills = sum(1 for inc in incarnations if inc["rc"] == 43)
+    rejected = sum((inc["state"] or {}).get("publish_rejections", 0)
+                   for inc in incarnations)
+    quarantined = sum((inc["state"] or {}).get("quarantined", 0)
+                      for inc in incarnations)
+    report["kills"] = kills
+    report["publish_rejections"] = rejected
+    report["quarantined"] = quarantined
+
+    failures = []
+    c = report["load"]["counts"]
+    if c["crashed"] or c["error"]:
+        failures.append(f"{c['crashed']} crashed + {c['error']} "
+                        "unstructured errors — degradation contract broken")
+    if c["invalid"]:
+        failures.append(
+            f"{c['invalid']} responses whose predictions do not re-score "
+            "under the checkpoint version they claim served them")
+    if c["ok"] == 0:
+        failures.append("no request succeeded")
+    if not incarnations or incarnations[-1]["rc"] != 0:
+        failures.append("the trainer ring never completed cleanly "
+                        f"(incarnations: {[i['rc'] for i in incarnations]})")
+    for inc in incarnations:
+        if inc["rc"] not in (0, 43):
+            failures.append(f"incarnation {inc['incarnation']} died with "
+                            f"unexpected rc={inc['rc']}")
+        if (inc["incarnation"] > 1 and inc["state"] is not None
+                and inc["state"].get("resumed_from")
+                != inc["expected_resume"]):
+            failures.append(
+                f"incarnation {inc['incarnation']} resumed from "
+                f"{inc['state'].get('resumed_from')}, not the last valid "
+                f"manifest {inc['expected_resume']}")
+    if report["swaps_completed"] < 2:
+        failures.append(f"only {report['swaps_completed']} hot swaps "
+                        "completed (wanted >= 2)")
+    if report["final_version"] != last_step:
+        failures.append(f"final version {report['final_version']} != "
+                        f"last published step {last_step}")
+    if plan_active:
+        if kills < 1:
+            failures.append("the mid-round trainer kill never fired")
+        if rejected < 1:
+            failures.append("the torn publish was never rejected "
+                            "(truncate rule not reaching the verify?)")
+        if ("serve.request", "http_status") not in fired:
+            failures.append("the 503 storm never fired")
+        if c["shed"] == 0:
+            failures.append("storm active but nothing shed")
+    if quarantined < 1:
+        failures.append("the poisoned spool file was never quarantined")
+    series = report["load"]["drift"]["series"]
+    if len(series) < 6:
+        failures.append(f"drift canary has only {len(series)} windows")
+    else:
+        third = len(series) // 3
+        early = sum(w["mean_prediction"] for w in series[:third]) / third
+        late = sum(w["mean_prediction"]
+                   for w in series[-third:]) / third
+        report["drift_early"] = round(early, 4)
+        report["drift_late"] = round(late, 4)
+        if late - early < 0.15:
+            failures.append(
+                f"scoring drift {early:.3f} -> {late:.3f} does not track "
+                "the shifted label distribution (wanted rise >= 0.15)")
+    report["slo_ok"] = not failures
+    report["slo_failures"] = failures
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    print(json.dumps({k: v for k, v in report.items()
+                      if k not in ("checkpoint_dir", "incarnations")},
+                     indent=1, sort_keys=True))
+    print(f"\ncontinuous ring: {len(incarnations)} trainer "
+          f"incarnation(s), {kills} kill(s) survived, "
+          f"{report['swaps_completed']} hot swaps, final v"
+          f"{report['final_version']}, {rejected} rejected publish(es), "
+          f"{quarantined} quarantined batch(es)")
+    if "drift_early" in report:
+        print(f"scoring drift: {report['drift_early']} -> "
+              f"{report['drift_late']} over {len(series)} windows")
+    for msg in failures:
+        print(f"CONTINUOUS FAILURE: {msg}")
+    return 0 if not failures else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -346,11 +671,23 @@ def main(argv=None) -> int:
     lc.add_argument("--duration", type=float, default=5.0)
     lc.add_argument("--swap-interval", type=float, default=1.2,
                     help="seconds between published versions")
+    ct = sub.add_parser("continuous",
+                        help="whole-ring trainer-daemon chaos drill")
+    ct.add_argument("--out", default=None)
+    ct.add_argument("--fault-plan", default=CONTINUOUS_PLAN,
+                    help="plan JSON path, or 'none' to disable injection")
+    ct.add_argument("--files", type=int, default=14,
+                    help="spool files written (label rate shifts across "
+                         "them; one is poisoned)")
+    ct.add_argument("--qps", type=float, default=40.0)
+    ct.add_argument("--duration", type=float, default=75.0)
     args = p.parse_args(argv)
     if args.cmd == "smoke":
         return run_smoke(args)
     if args.cmd == "lifecycle":
         return run_lifecycle(args)
+    if args.cmd == "continuous":
+        return run_continuous(args)
     return run_knee(args)
 
 

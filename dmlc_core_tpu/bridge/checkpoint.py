@@ -31,7 +31,7 @@ import struct
 import threading
 import time
 import zlib
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -317,6 +317,10 @@ class CheckpointManager:
         self.directory = directory.rstrip("/")
         self.keep = keep
         self._async = AsyncCheckpointer()
+        # _retain runs on whatever thread made the step durable (the async
+        # writer thread, a trainer's publish clock) — the once-only
+        # retention warning flag needs a lock like any shared write
+        self._warn_lock = threading.Lock()
         self._warned_retention = False
         self._is_local = "://" not in directory or \
             directory.startswith("file://")
@@ -352,14 +356,70 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
-    def save(self, step: int, tree: Any, async_: bool = True) -> None:
-        uri = self._step_uri(step)
+    def latest_valid(self, *, above: int = -1,
+                     known_bad: Iterable[Tuple[int, Any]] = (),
+                     verify: bool = False,
+                     skip_unpublished: bool = False) \
+            -> Tuple[Optional[int], Optional[Dict[str, Any]]]:
+        """The newest trustworthy step, manifest-first: ``(step, manifest)``
+        or ``(None, None)``.
+
+        One scan, two callers (the fallback-past-bad-steps logic must exist
+        exactly once): the serving :class:`~dmlc_core_tpu.serve.lifecycle.
+        CheckpointWatcher` candidate pick and the continuous trainer's
+        crash-resume.  Newest first, skipping every ``(step, crc32)`` pair
+        in ``known_bad`` (the watcher's rejected-candidate ledger), and
+        stopping at ``above`` exclusive.
+
+        A step without a parseable manifest stops the scan by default —
+        its write may still be in flight, and falling back to an older
+        step would just churn a watcher (watch semantics).  With
+        ``skip_unpublished=True`` it is skipped instead: a resuming
+        trainer KNOWS the previous writer is dead, so a manifest-less
+        newest step is an abandoned publish, not an in-flight one.
+
+        ``verify=True`` additionally re-hashes each candidate's blob
+        against its manifest (:func:`verify_checkpoint`) and falls back
+        past corrupt/truncated steps — resume must never restore bytes
+        the serving validate stage would reject.
+        """
+        bad = set(known_bad)
+        for step in reversed(self.all_steps()):
+            if step <= above:
+                return None, None
+            manifest = self.read_manifest(step)
+            if manifest is None:
+                if skip_unpublished:
+                    continue
+                return None, None
+            if (step, manifest.get("crc32")) in bad:
+                continue
+            if verify:
+                try:
+                    verify_checkpoint(self.step_uri(step), manifest)
+                except Exception as e:
+                    log_warning(f"checkpoint step {step} fails its "
+                                f"manifest ({e}); falling back past it")
+                    continue
+            return step, manifest
+        return None, None
+
+    def prepare_step(self, step: int) -> str:
+        """Make the step's URI writable and return it: ensure the local
+        directory exists and sweep temp orphans a crashed previous writer
+        of this step left behind (pid-unique temp names would otherwise
+        accumulate); live writers' temps are skipped.  No-op on remote
+        stores.  External publishers (the continuous trainer's
+        temp+verify+manifest-last sequence) call this before their own
+        :func:`save_checkpoint`."""
+        uri = self.step_uri(step)
         if self._is_local:
             os.makedirs(_strip_file_scheme(self.directory), exist_ok=True)
-            # sweep temp orphans a crashed previous writer of this step left
-            # behind (pid-unique temp names would otherwise accumulate);
-            # live writers' temps are skipped
             _sweep_orphan_temps(_strip_file_scheme(uri))
+        return uri
+
+    def save(self, step: int, tree: Any, async_: bool = True) -> None:
+        uri = self.prepare_step(step)
         if async_:
             # manifest + retention run on the writer thread only once the
             # new step is durable — publishing the manifest earlier would
@@ -380,6 +440,12 @@ class CheckpointManager:
         bytes are still in flight."""
         self.write_manifest(step, summary)
         self._retain(step)
+
+    def publish(self, step: int, summary: Dict[str, Any]) -> None:
+        """Publish a step an external writer already made durable (and
+        verified): manifest-last + retention.  The tail of the continuous
+        trainer's temp+verify+manifest-last publish."""
+        self._publish(step, summary)
 
     def write_manifest(self, step: int, summary: Dict[str, Any]) -> None:
         manifest = {
@@ -461,10 +527,12 @@ class CheckpointManager:
         if not self._is_local:
             # retention only deletes local checkpoints; skip the (remote)
             # listing round-trip entirely on the hot save path
-            if not self._warned_retention:
+            with self._warn_lock:
+                warn, self._warned_retention = \
+                    not self._warned_retention, True
+            if warn:
                 log_warning("CheckpointManager retention only deletes local "
                             "checkpoints; remote steps are left in place")
-                self._warned_retention = True
             return
         # current_step is durable by the time retention runs (sync path, or
         # the writer thread's on_durable hook); the union guards against a

@@ -363,6 +363,33 @@ def _get_shared_pool(nproc: int) -> Tuple[ProcessPoolExecutor, int]:
         return _shared_pool, _shared_size
 
 
+def _unstick_call_queue(queue) -> None:
+    """Free a feeder thread wedged on the call-queue pipe of a broken pool.
+
+    CPython's executor (the gh-94777 deadlock lineage, unfixed on 3.10):
+    after a worker is killed, ``terminate_broken`` joins the call queue's
+    feeder thread — but with every worker dead nothing drains the call
+    queue, ``Queue.close`` never closes the parent's read end (no EPIPE),
+    and a feeder blocked mid-write on a full pipe never returns, so the
+    executor's management thread parks on the join and interpreter exit
+    then hangs in ``_python_exit`` forever.  Draining the parent-side read
+    end lets the blocked write complete and the feeder reach its close
+    sentinel.  Only safe on a BROKEN pool: with workers alive this would
+    steal their work items off the shared pipe.  Takes the queue, not the
+    executor — ``Executor.shutdown`` nulls ``_call_queue``."""
+    feeder = getattr(queue, "_thread", None)
+    reader = getattr(queue, "_reader", None)
+    if feeder is None or reader is None:
+        return
+    deadline = time.monotonic() + 10.0
+    while feeder.is_alive() and time.monotonic() < deadline:
+        try:
+            if reader.poll(0.05):
+                reader.recv_bytes()
+        except (OSError, EOFError):
+            break
+
+
 def _discard_shared_pool(pool: ProcessPoolExecutor) -> None:
     """Drop a broken pool so the next parser self-heals with a fresh one."""
     global _shared_pool, _shared_size
@@ -370,7 +397,9 @@ def _discard_shared_pool(pool: ProcessPoolExecutor) -> None:
         if _shared_pool is pool:
             _shared_pool, _shared_size = None, 0
             telemetry.gauge_set("dmlc_parse_proc_workers", 0)
+    queue = getattr(pool, "_call_queue", None)   # shutdown() nulls it
     pool.shutdown(wait=False, cancel_futures=True)
+    _unstick_call_queue(queue)
 
 
 def engaged() -> bool:

@@ -29,7 +29,10 @@ Injection sites (see :data:`SITES`):
   service's ingress, batch assembly, and model call (docs/serving.md);
 - ``serve.swap``           — the model-lifecycle watcher's
   watch/validate/warmup/swap stages (hot-swap chaos: a rejected candidate
-  must leave previous-good serving).
+  must leave previous-good serving);
+- ``train.ingest`` / ``train.round`` / ``train.publish`` — the continuous
+  trainer daemon's batch fetch, boosting round, and checkpoint publish
+  (kill-mid-round and torn-publish chaos: docs/training.md).
 
 **Disabled is the default and costs one attribute load + branch**: every
 helper returns immediately while no plan is configured, and the instrumented
@@ -129,6 +132,24 @@ SITES: Dict[str, str] = {
         "— previous-good keeps serving; 'stall' during swap delays the "
         "pointer flip but can never tear it (docs/serving.md \"Model "
         "lifecycle\")"),
+    "train.ingest": (
+        "continuous trainer, once per batch fetch before the source is "
+        "read (ctx: cursor=<position>, incarnation=<n>); 'error'/'reset' "
+        "model a flaky source — the fetch is retried next tick, the "
+        "cursor does not advance (docs/training.md)"),
+    "train.round": (
+        "continuous trainer, once per boosting round before it computes "
+        "(ctx: round=<odometer>, incarnation=<n>); 'exit' kills the "
+        "trainer mid-round — restart must resume from the last valid "
+        "manifest with the rounds since it retrained, never a torn "
+        "checkpoint (the continuous chaos drill)"),
+    "train.publish": (
+        "continuous trainer checkpoint publish (ctx: step=<n>, "
+        "phase=begin|durable, incarnation=<n>); 'exit' at phase=durable "
+        "kills between blob and manifest — the step must never become a "
+        "swap candidate; 'truncate' at phase=durable tears the durable "
+        "blob before the publish-side verify, which must reject the step "
+        "and re-publish it idempotently"),
 }
 
 _plan: Optional[FaultPlan] = None

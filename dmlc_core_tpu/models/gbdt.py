@@ -645,6 +645,11 @@ class GBDT:
         # finite values quantile-bin into [0, num_bins - 2]
         eff_bins = (self.param.num_bins - 1 if self.param.handle_missing
                     else self.param.num_bins)
+        # safe publication, not a race: the continuous trainer fits edges
+        # once on its ingest thread and only then publishes the ensemble
+        # under its lock; the publish clock cannot reach a boundaries read
+        # until it observes that ensemble under the same lock
+        # dmlclint: disable=race-unlocked-shared-write
         self.boundaries = distributed_quantile_boundaries(
             sample, eff_bins, comm=comm, count=count)
         return self.boundaries
@@ -937,6 +942,77 @@ class GBDT:
                                            batch=bins.shape[0]))(
             margin, bins, label, weight,
             jnp.asarray(round_index, jnp.uint32))
+
+    def append_rounds(self, ensemble: Optional[TreeEnsemble], bins, label,
+                      weight=None, *, num_rounds: int = 1,
+                      margin=None, start_round: Optional[int] = None
+                      ) -> Tuple[TreeEnsemble, Any]:
+        """Append ``num_rounds`` boosting rounds trained on fresh (binned)
+        data — the warm-start step of the continuous training ring
+        (docs/training.md).  Returns ``(extended ensemble, final margin)``.
+
+        The margin is seeded from the existing ensemble's own predictions
+        on ``bins`` (pass ``margin`` to chain calls over the same batch
+        without re-predicting).  The bin boundaries are NOT refit: the
+        restored edges stay frozen, so the serving-side uint8 wire stays
+        bitwise identical across refreshes.  ``start_round`` seeds the
+        per-tree subsample/colsample draw — it defaults to
+        ``ensemble.num_trees`` so appended trees continue the fresh-fit
+        draw sequence instead of repeating it.
+
+        ``ensemble=None`` starts a new ensemble from the base margin (the
+        trainer's cold start: same sequence a fresh streaming fit runs).
+        """
+        import jax.numpy as jnp
+
+        CHECK(num_rounds >= 1, "append_rounds needs num_rounds >= 1")
+        bins = jnp.asarray(bins)
+        label = jnp.asarray(label, jnp.float32)
+        weight = (jnp.ones(bins.shape[0], jnp.float32)
+                  if weight is None else jnp.asarray(weight))
+        K = (self.param.num_class if self.param.objective == "softmax"
+             else 1)
+        if margin is None:
+            if ensemble is None:
+                shape = (bins.shape[0], K) if K > 1 else (bins.shape[0],)
+                margin = jnp.full(shape, self.param.base_score, jnp.float32)
+            else:
+                margin = self.predict_margin(ensemble, bins)
+        if start_round is None:
+            start_round = 0 if ensemble is None else ensemble.num_trees
+        new = []
+        for r in range(num_rounds):
+            margin, tree = self.boost_round(margin, bins, label, weight,
+                                            round_index=start_round + r)
+            new.append(tree)
+
+        def stack(i):
+            return np.stack([np.asarray(t[i]) for t in new], axis=0)
+
+        def cat(old, i, dtype=None):
+            fresh = stack(i)
+            if dtype is not None:
+                fresh = fresh.astype(dtype)
+            if old is None:      # ensemble=None: the fresh trees ARE it
+                return fresh
+            old = np.asarray(old)
+            return np.concatenate([old, fresh.astype(old.dtype)], axis=0)
+
+        if ensemble is None:
+            ensemble = TreeEnsemble(None, None, None, None, None, None)
+        # pre-stats ensembles (old checkpoints) carry split_gain/cover =
+        # None: keep them None — mixing absent and present stats would
+        # fork the checkpoint schema mid-stream
+        has_stats = (ensemble.split_feat is None
+                     or ensemble.split_gain is not None)
+        return TreeEnsemble(
+            cat(ensemble.split_feat, 0),
+            cat(ensemble.split_bin, 1),
+            cat(ensemble.leaf_value, 2),
+            cat(ensemble.default_left, 3, dtype=bool),
+            cat(ensemble.split_gain, 4) if has_stats else None,
+            cat(ensemble.split_cover, 5) if has_stats else None,
+        ), margin
 
     def predict_margin(self, ensemble: TreeEnsemble, bins):
         return self._predict_fn()(ensemble, bins)
@@ -1376,7 +1452,8 @@ class GBDT:
                             None if sg is None else np.asarray(sg),
                             None if sc is None else np.asarray(sc))
 
-    def serving_state(self, ensemble: TreeEnsemble) -> dict:
+    def serving_state(self, ensemble: TreeEnsemble,
+                      extra: Optional[dict] = None) -> dict:
         """Self-describing checkpoint pytree for the model-lifecycle path
         (docs/serving.md): the :meth:`save_model` payload plus a
         ``serve_meta`` leaf recording everything a loader needs to rebuild
@@ -1387,14 +1464,21 @@ class GBDT:
 
         Feed this to :class:`~dmlc_core_tpu.bridge.checkpoint.
         CheckpointManager`.save and restore with :meth:`from_serving_state`.
+        ``extra`` adds caller-owned leaves on top (the continuous trainer's
+        ingest cursor rides the same atomic blob as the trees it trained);
+        unknown keys are ignored by every loader.
         """
-        return self._model_payload(ensemble, extra={
+        merged = {
             _SERVE_META_KEY: np.array(
                 [_SERVE_SCHEMA, self.num_feature, self.param.num_bins,
                  self.param.max_depth,
                  _OBJECTIVE_CODES[self.param.objective],
                  self.param.num_class],
-                np.int64)})
+                np.int64)}
+        for k, v in (extra or {}).items():
+            CHECK(k != _SERVE_META_KEY, "extra must not override serve_meta")
+            merged[k] = v
+        return self._model_payload(ensemble, merged)
 
     @classmethod
     def from_serving_state(cls, flat: dict) -> Tuple["GBDT", TreeEnsemble]:
@@ -1427,6 +1511,36 @@ class GBDT:
             base_score=float(bs[0]) if bs is not None else 0.0)
         gbdt = cls(param, num_feature)
         return gbdt, gbdt.load_model_dict(flat)
+
+    @classmethod
+    def resume(cls, flat: dict,
+               param: Optional[GBDTParam] = None
+               ) -> Tuple["GBDT", TreeEnsemble]:
+        """Warm-start restore for continuous training: rebuild
+        ``(GBDT, ensemble)`` from a :meth:`serving_state` checkpoint with
+        the binner edges frozen from the restored state, ready for
+        :meth:`append_rounds` against fresh data.
+
+        ``serve_meta`` records only the structural contract (bins, depth,
+        objective, classes) — not training hyperparameters like
+        learning_rate or regularisation.  Pass ``param`` to supply those
+        for the appended rounds; its structural fields must match the
+        checkpoint (they define the routing + binning contract the uint8
+        serving wire depends on — the whole point of resume over refit is
+        that the wire stays bitwise skew-free).
+        """
+        gbdt, ensemble = cls.from_serving_state(flat)
+        if param is None:
+            return gbdt, ensemble
+        for f in ("objective", "num_bins", "max_depth", "num_class"):
+            CHECK(getattr(param, f) == getattr(gbdt.param, f),
+                  f"resume param {f}={getattr(param, f)!r} != checkpoint "
+                  f"{f}={getattr(gbdt.param, f)!r}; the structural "
+                  f"contract is frozen by the serving checkpoint")
+        # handle_missing/base_score mismatches are refused inside
+        # load_model_dict (the binning/margin contracts)
+        out = cls(param, gbdt.num_feature)
+        return out, out.load_model_dict(flat)
 
 
 # serving_state schema: bump when the serve_meta layout changes

@@ -224,31 +224,20 @@ class CheckpointWatcher:
         """The newest *published, not-known-bad* step above the slot's
         version, manifest included — or ``(None, None)``.
 
-        Newest-first with fallback (the watch twin of
-        ``CheckpointManager.restore``): a rejected newest step must not
-        pin the slot to stale previous-good forever when an older valid
-        unswapped step sits in the store — e.g. the trainer published
-        v2 then a corrupt v3 and stopped.  A step with no manifest yet
-        stops the scan instead of being leapfrogged: its write is in
-        flight and swapping to an older step now would just churn.
+        The scan itself is :meth:`CheckpointManager.latest_valid` (shared
+        with the continuous trainer's crash-resume so the fallback-past-
+        bad-steps logic exists exactly once).  Newest-first with fallback:
+        a rejected newest step must not pin the slot to stale
+        previous-good forever when an older valid unswapped step sits in
+        the store — e.g. the trainer published v2 then a corrupt v3 and
+        stopped.  A step with no manifest yet stops the scan instead of
+        being leapfrogged: its write is in flight and swapping to an
+        older step now would just churn.
         """
-        steps = self.manager.all_steps()
         current = slot.version if isinstance(slot.version, int) else -1
-        for step in reversed(steps):
-            if step <= current:
-                return None, None
-            manifest = self.manager.read_manifest(step)
-            if manifest is None:
-                # manifest-first discipline: the blob may still be in
-                # flight on a store without atomic rename — do not even
-                # open it, and do not skip past it
-                return None, None
-            with self._lock:
-                known_bad = (step, manifest.get("crc32")) in self._rejected
-            if known_bad:
-                continue  # known-bad bytes: fall back to the next-newest
-            return step, manifest
-        return None, None
+        with self._lock:
+            known_bad = frozenset(self._rejected)
+        return self.manager.latest_valid(above=current, known_bad=known_bad)
 
     def _reject(self, step, manifest, stage: str, exc: Exception,
                 slot) -> None:

@@ -33,6 +33,15 @@ and the report lists the **top-5 slowest trace ids** — so the worst-p99
 offenders in an SLO report can be looked up directly in the merged trace
 (``python -m dmlc_core_tpu.telemetry trace <dir>``) instead of being
 anonymous latency numbers.
+
+The report also carries a **scoring-drift canary**: every ``ok``
+response's mean prediction is bucketed by its *scheduled* arrival window
+(``drift_window_s``, default 1 s), and the ``drift`` block reports the
+per-window mean-prediction series.  Against a fixed model the series is
+flat noise; under continuous training it visibly tracks the data
+distribution the trainer is absorbing — the continuous chaos drill gates
+on the series moving monotonically with its shifted label rate
+(docs/serving.md "Scoring-drift canary").
 """
 
 from __future__ import annotations
@@ -88,6 +97,9 @@ class _Recorder:
         # (latency_s, trace_id, outcome, status) per request — the single
         # store every latency view (quantiles, slowest table) derives from
         self.samples: List[Tuple[float, str, str, Optional[int]]] = []
+        # drift canary: window index -> [n_requests, sum of per-request
+        # mean predictions] over ok responses only
+        self.drift: Dict[int, List[float]] = {}
 
     def record(self, outcome: str, latency_s: float,
                status: Optional[int], trace_id: str) -> None:
@@ -97,6 +109,19 @@ class _Recorder:
                 key = str(status)
                 self.statuses[key] = self.statuses.get(key, 0) + 1
             self.samples.append((latency_s, trace_id, outcome, status))
+
+    def record_drift(self, window: int, mean_prediction: float) -> None:
+        with self.lock:
+            acc = self.drift.setdefault(window, [0, 0.0])
+            acc[0] += 1
+            acc[1] += mean_prediction
+
+    def drift_series(self, window_s: float) -> List[Dict[str, Any]]:
+        with self.lock:
+            items = sorted(self.drift.items())
+        return [{"window": w, "t_s": round(w * window_s, 3), "n": n,
+                 "mean_prediction": round(total / n, 6)}
+                for w, (n, total) in items if n]
 
     def latencies(self, outcome: Optional[str] = None) -> List[float]:
         with self.lock:
@@ -111,10 +136,23 @@ class _Recorder:
                 for lat, t, outcome, status in worst]
 
 
+def _mean_prediction(preds: List[Any]) -> Optional[float]:
+    """Mean over a predictions list (scalars, or per-class rows for
+    softmax — flattened); None when nothing numeric is there."""
+    flat: List[float] = []
+    for p in preds:
+        if isinstance(p, (int, float)):
+            flat.append(float(p))
+        elif isinstance(p, list):
+            flat.extend(float(v) for v in p
+                        if isinstance(v, (int, float)))
+    return sum(flat) / len(flat) if flat else None
+
+
 def _issue(url: str, path: str, body: bytes, timeout_s: float,
-           expect_rows: int, traceparent: str,
+           expect_rows: int, traceparent: str, rows=None,
            response_check=None) -> tuple:
-    """One POST; returns (outcome, status|None)."""
+    """One POST; returns (outcome, status|None, mean_prediction|None)."""
     req = urllib.request.Request(
         url + path, data=body,
         headers={"Content-Type": "application/json",
@@ -125,14 +163,15 @@ def _issue(url: str, path: str, body: bytes, timeout_s: float,
             preds = payload.get("predictions")
             if isinstance(preds, list) and len(preds) == expect_rows:
                 if response_check is not None \
-                        and not response_check(payload):
+                        and not response_check(payload, rows):
                     # a well-formed 200 that is WRONG (e.g. predictions
                     # inconsistent with the version it claims): worse
                     # than a shed, and the one outcome a half-swapped
                     # model could produce
-                    return "invalid", resp.status
-                return "ok", resp.status
-            return "crashed", resp.status  # 200 with a wrong-shaped body
+                    return "invalid", resp.status, None
+                return "ok", resp.status, _mean_prediction(preds)
+            # 200 with a wrong-shaped body
+            return "crashed", resp.status, None
     except urllib.error.HTTPError as e:
         status = e.code
         try:
@@ -141,39 +180,42 @@ def _issue(url: str, path: str, body: bytes, timeout_s: float,
         except Exception:
             structured = False
         if not structured:
-            return "crashed", status
+            return "crashed", status, None
         if status == 503:
-            return "shed", status
+            return "shed", status, None
         if status == 504:
-            return "timeout", status
+            return "timeout", status, None
         if 400 <= status < 500:
-            return "rejected", status
-        return "error", status
+            return "rejected", status, None
+        return "error", status, None
     except TimeoutError:
-        return "timeout", None
+        return "timeout", None, None
     except urllib.error.URLError as e:
         # urllib wraps connect-phase deadline expiry in URLError: that is
         # the client's deadline, not a server crash
         if isinstance(getattr(e, "reason", None), TimeoutError):
-            return "timeout", None
-        return "crashed", None
+            return "timeout", None, None
+        return "crashed", None, None
     except (ConnectionError, OSError):
-        return "crashed", None
+        return "crashed", None, None
     except Exception:
-        return "crashed", None
+        return "crashed", None, None
 
 
 def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
              rows_per_request: int = 1, seed: int = 0,
              timeout_s: float = 10.0, max_workers: int = 64,
              model: Optional[str] = None,
-             response_check=None) -> LoadReport:
+             response_check=None,
+             drift_window_s: float = 1.0) -> LoadReport:
     """Drive open-loop traffic at ``qps`` for ``duration_s``; returns the
     SLO report dict (see module docstring for the outcome taxonomy).
 
     ``model`` routes every request to ``/v1/score/<model>`` (multi-model
-    serving); ``response_check(payload) -> bool`` classifies a well-formed
-    200 whose body is semantically wrong as ``invalid``."""
+    serving); ``response_check(payload, rows) -> bool`` (``rows`` = the
+    instances this request sent) classifies a well-formed 200 whose body
+    is semantically wrong as ``invalid``; ``drift_window_s`` sets the
+    scoring-drift canary's bucketing (report ``drift`` block)."""
     from concurrent.futures import ThreadPoolExecutor
 
     path = "/v1/score" if model is None else f"/v1/score/{model}"
@@ -187,13 +229,14 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         if t >= duration_s:
             break
         arrivals.append(t)
-    bodies = [json.dumps({"instances": _gen_rows(rng, rows_per_request,
-                                                 num_feature)}).encode()
-              for _ in arrivals]
+    rows_sent = [_gen_rows(rng, rows_per_request, num_feature)
+                 for _ in arrivals]
+    bodies = [json.dumps({"instances": rows}).encode()
+              for rows in rows_sent]
     rec = _Recorder()
     start = clock.monotonic()
 
-    def fire(scheduled_at: float, body: bytes) -> None:
+    def fire(scheduled_at: float, body: bytes, rows) -> None:
         # each request roots a fresh trace.  The header is attached even
         # when THIS process collects nothing (the W3C propagation norm:
         # the server side may be tracing — its spans then carry ids the
@@ -205,21 +248,28 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         tp = tracecontext.format_traceparent(
             tracecontext.TraceContext(trace_id, span_id))
         t0 = clock.monotonic()
-        outcome, status = _issue(url, path, body, timeout_s,
-                                 rows_per_request, tp, response_check)
+        outcome, status, mean_pred = _issue(url, path, body, timeout_s,
+                                            rows_per_request, tp, rows,
+                                            response_check)
         t1 = clock.monotonic()
         telemetry.record_span("client.request", t0, t1,
                               trace=(trace_id, span_id, None),
                               outcome=outcome, status=status or 0)
         rec.record(outcome, t1 - start - scheduled_at, status, trace_id)
+        if mean_pred is not None:
+            # bucket by SCHEDULED time: the canary plots what the model
+            # answered for traffic offered at t, not when it got around
+            # to answering it
+            rec.record_drift(int(scheduled_at // drift_window_s),
+                             mean_pred)
 
     with ThreadPoolExecutor(max_workers=max_workers,
                             thread_name_prefix="loadgen") as pool:
-        for at, body in zip(arrivals, bodies):
+        for at, body, rows in zip(arrivals, bodies, rows_sent):
             delay = at - (clock.monotonic() - start)
             if delay > 0:
                 time.sleep(delay)
-            pool.submit(fire, at, body)
+            pool.submit(fire, at, body, rows)
         # pool __exit__ joins all in-flight requests
     wall = clock.monotonic() - start
 
@@ -252,6 +302,11 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         # the worst offenders BY NAME: feed these ids to
         # `telemetry trace <dir>` to see where each one's time went
         "slowest_traces": rec.slowest(SLOWEST_TRACES),
+        # scoring-drift canary: per-window mean prediction of ok answers
+        "drift": {
+            "window_s": drift_window_s,
+            "series": rec.drift_series(drift_window_s),
+        },
     }
     server_stats = _fetch_stats(url, timeout_s)
     if server_stats is not None:

@@ -1,0 +1,14 @@
+"""Continuous training ring: crash-tolerant trainer daemon (docs/training.md).
+
+``python -m dmlc_core_tpu.train`` runs :class:`~.daemon.TrainerDaemon`
+against a spool directory (:class:`~.source.DirectorySource`) or the
+PR 12 shard-lease fleet (:class:`~.source.FleetSource`), publishing
+manifest-first checkpoints the PR 13 serving watcher hot-swaps live.
+"""
+
+from dmlc_core_tpu.train.daemon import CURSOR_KEY, ROUND_KEY, TrainerDaemon
+from dmlc_core_tpu.train.source import (Batch, DirectorySource, DONE_SENTINEL,
+                                        FleetSource)
+
+__all__ = ["TrainerDaemon", "DirectorySource", "FleetSource", "Batch",
+           "DONE_SENTINEL", "CURSOR_KEY", "ROUND_KEY"]

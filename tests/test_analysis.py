@@ -3912,3 +3912,29 @@ def test_cli_jobs_output_matches_serial(tmp_path, capsys):
     parallel = capsys.readouterr().out
     assert rc_serial == rc_jobs == 1
     assert parallel == serial
+
+
+def test_seeded_unlocked_odometer_in_real_train_daemon():
+    """The continuous trainer rides the full race gate: its odometers are
+    bumped from the ingest loop AND the publish clock thread, so stripping
+    the lock from the rejection bump must trip exactly one unlocked-write
+    finding."""
+    src = _real_source("dmlc_core_tpu/train/daemon.py")
+    broken = src.replace(
+        "            with self._lock:\n"
+        "                self.publish_rejections += 1",
+        "            self.publish_rejections += 1")
+    assert broken != src, "fix shape changed; update the seeding"
+    found = _races_on_sources({"dmlc_core_tpu/train/daemon.py": broken})
+    assert [(f.rule, f.symbol) for f in found] == \
+        [("race-unlocked-shared-write", "TrainerDaemon.publish_rejections")]
+
+
+def test_real_train_daemon_is_race_clean():
+    found = _races_on_sources({
+        "dmlc_core_tpu/train/daemon.py":
+            _real_source("dmlc_core_tpu/train/daemon.py"),
+        "dmlc_core_tpu/train/source.py":
+            _real_source("dmlc_core_tpu/train/source.py"),
+    })
+    assert found == []

@@ -313,6 +313,98 @@ def test_checkpoint_manager_wide_step_numbers(tmp_path):
     np.testing.assert_array_equal(got["w"], np.ones(2))
 
 
+def test_checkpoint_manager_latest_valid_scan(tmp_path):
+    """latest_valid is the ONE shared fallback scan (watcher candidate pick
+    AND trainer crash-resume): manifest-first, newest-first, honouring the
+    above floor and the rejected-candidate ledger."""
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=10)
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": np.full(2, float(step))}, async_=False)
+
+    step, manifest = mgr.latest_valid()
+    assert step == 3 and manifest is not None
+    assert manifest["step"] == 3
+    # the floor is exclusive: nothing newer than the current version -> miss
+    assert mgr.latest_valid(above=3) == (None, None)
+    assert mgr.latest_valid(above=2)[0] == 3
+
+    # a rejected (step, crc32) pair falls back to the next-older step
+    bad = {(3, manifest["crc32"])}
+    step2, manifest2 = mgr.latest_valid(known_bad=bad)
+    assert step2 == 2 and manifest2["step"] == 2
+    # ...but a stale ledger entry (same step, different bytes) does not hide
+    # a re-published step
+    assert mgr.latest_valid(known_bad={(3, manifest["crc32"] ^ 1)})[0] == 3
+
+
+def test_checkpoint_manager_latest_valid_unpublished_newest(tmp_path):
+    """A manifest-less newest step stops the scan by default (its writer may
+    still be in flight — watcher semantics) but is skipped for a resuming
+    trainer, which knows the previous writer is dead."""
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=10)
+    mgr.save(1, {"w": np.full(2, 1.0)}, async_=False)
+    # a blob with no manifest: an in-flight (or abandoned) publish
+    orphan = tmp_path / "ckpts" / "ckpt-00000002"
+    orphan.write_bytes((tmp_path / "ckpts" / "ckpt-00000001").read_bytes())
+
+    assert mgr.latest_valid() == (None, None)            # watcher: wait
+    step, manifest = mgr.latest_valid(skip_unpublished=True)
+    assert step == 1 and manifest["step"] == 1           # trainer: fall back
+
+
+def test_checkpoint_manager_latest_valid_verify_falls_past_rot(tmp_path):
+    """verify=True re-hashes each candidate blob and falls back past
+    corrupt/truncated steps whose manifests still parse."""
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=10)
+    mgr.save(1, {"w": np.full(2, 1.0)}, async_=False)
+    mgr.save(2, {"w": np.full(2, 2.0)}, async_=False)
+    # bit-rot the newest blob AFTER publish: manifest says one thing, the
+    # bytes say another
+    blob = tmp_path / "ckpts" / "ckpt-00000002"
+    blob.write_bytes(blob.read_bytes()[:-4] + b"\x00\x00\x00\x00")
+
+    # manifest-only scan still trusts step 2...
+    assert mgr.latest_valid()[0] == 2
+    # ...but a verifying scan (trainer resume) falls back to step 1
+    step, manifest = mgr.latest_valid(verify=True)
+    assert step == 1 and manifest["step"] == 1
+
+
+def test_checkpoint_manager_remote_retention_warns_once(tmp_path):
+    """On a remote store retention is a no-op and the 'remote steps are left
+    in place' warning fires exactly once per manager, not per save."""
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+    from dmlc_core_tpu.utils import logging as L
+
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), keep=2)
+    mgr.save(1, {"w": np.zeros(2)}, async_=False)
+    mgr.save(2, {"w": np.zeros(2)}, async_=False)
+    # flip the manager to remote semantics AFTER the local writes so _retain
+    # exercises the remote branch without needing a remote filesystem; keep=2
+    # would delete step 1 on a local manager if another step landed
+    mgr._is_local = False
+    mgr.keep = 1
+    captured = []
+    L.set_log_sink(lambda sev, line: captured.append((sev, line)))
+    try:
+        mgr._retain(2)
+        mgr._retain(3)
+        mgr._retain(4)
+    finally:
+        L.set_log_sink(None)
+    warnings = [line for sev, line in captured
+                if sev == L.WARNING and "remote steps are left in place" in line]
+    assert len(warnings) == 1
+    # and nothing was deleted: remote retention must not touch steps
+    assert mgr.all_steps() == [1, 2]
+
+
 def test_num_rows_with_explicit_row_weights(tmp_path):
     """Explicitly-weighted libsvm rows (label:weight) must not corrupt the
     real-row count: num_rows is structural, not weight.sum()."""

@@ -550,3 +550,49 @@ def test_serving_checkpoint_restore_keeps_the_skew_contract(tmp_path):
                                   rt.predict(probe))
     np.testing.assert_array_equal(restored.predict(probe),
                                   restored.predict_float(probe))
+
+def test_resumed_model_keeps_the_skew_contract(tmp_path):
+    """A continuous-training refresh (GBDT.resume + append_rounds) must not
+    move the serving wire: the restored edges are frozen, so the uint8
+    binning stays bitwise identical to apply_bins on the ORIGINAL edges,
+    and the refreshed checkpoint serves bitwise-consistently."""
+    from dmlc_core_tpu.bridge.checkpoint import (CheckpointManager,
+                                                 load_checkpoint)
+    from dmlc_core_tpu.serve.model_runtime import build_runtime
+
+    x, y = make_xy(n=1200, f=5, seed=4)
+    gbdt = GBDT(GBDTParam(objective="logistic", num_boost_round=3,
+                          max_depth=3, num_bins=64), x.shape[1])
+    gbdt.make_bins(x)
+    ensemble, _ = gbdt.fit_binned(gbdt.bin_features(x), y)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, gbdt.serving_state(ensemble), async_=False)
+
+    # the trainer daemon's refresh: resume from the checkpoint, append
+    # rounds on drifted data (the edges must NOT refit to it)
+    x2, y2 = make_xy(n=1200, f=5, seed=5)
+    x2 = x2 * 3.0 + 1.5        # would yield different edges if refit
+    resumed, ens2 = GBDT.resume(load_checkpoint(mgr.step_uri(1)))
+    ens3, _ = resumed.append_rounds(ens2, resumed.bin_features(x2), y2,
+                                    num_rounds=2)
+    assert ens3.num_trees == ensemble.num_trees + 2
+    np.testing.assert_array_equal(np.asarray(resumed.boundaries),
+                                  np.asarray(gbdt.boundaries))
+    mgr.save(2, resumed.serving_state(ens3), async_=False)
+
+    # serving the refreshed step: HostBinner wire == apply_bins on the
+    # ORIGINAL training edges, bitwise, on adversarial rows
+    rt = build_runtime("gbdt", x.shape[1], checkpoint=mgr.step_uri(2))
+    probe = np.array(x[:40])
+    probe[0, :] = gbdt.boundaries[np.arange(x.shape[1]), 0]
+    probe[1, :] = gbdt.boundaries[np.arange(x.shape[1]), -1]
+    probe[2, :] = np.inf
+    probe[3, :] = -np.inf
+    probe[4, :] = 0.0
+    want = np.asarray(apply_bins(probe, gbdt.boundaries))
+    got = rt.binner.transform(probe)
+    np.testing.assert_array_equal(got.astype(np.int32), want)
+    assert got.dtype == wire_dtype(gbdt.param.num_bins)
+    # and the uint8 wire path scores bitwise-equal to float binning
+    np.testing.assert_array_equal(rt.predict(probe),
+                                  rt.predict_float(probe))

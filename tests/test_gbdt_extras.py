@@ -891,3 +891,72 @@ def test_eval_metric_error_and_rmse():
     with pytest.raises(Exception, match="classification"):
         mr.fit_with_eval(br[:100], yr[:100], br[100:200], yr[100:200],
                          eval_metric="error")
+
+def test_resume_plus_k_rounds_matches_uninterrupted_streaming_fit(tmp_path):
+    """Warm-start contract of the continuous training ring: checkpoint
+    after k1 rounds, GBDT.resume, append k2 more -> same model as the
+    uninterrupted k1+k2 streaming fit, within float tolerance (the resumed
+    path re-predicts its seed margin instead of chaining the live one)."""
+    from dmlc_core_tpu.bridge.checkpoint import (load_checkpoint,
+                                                 save_checkpoint)
+
+    x, y = make_data(2000, 21)
+    param = GBDTParam(num_boost_round=8, max_depth=3, num_bins=32,
+                      learning_rate=0.3)
+    m = GBDT(param, num_feature=4)
+    m.make_bins(x)
+    bins = np.asarray(m.bin_features(x))
+
+    # the uninterrupted ring: 4 rounds, then 4 more chaining the margin
+    ens_mid, margin = m.append_rounds(None, bins, y, num_rounds=4)
+    ens_full, _ = m.append_rounds(ens_mid, bins, y, num_rounds=4,
+                                  margin=margin)
+
+    # crash after round 4: the checkpoint is the only survivor
+    uri = str(tmp_path / "ckpt-mid")
+    save_checkpoint(uri, m.serving_state(ens_mid))
+    m2, ens_restored = GBDT.resume(load_checkpoint(uri), param=param)
+
+    # restored edges are frozen bitwise -> identical uint8 bins
+    np.testing.assert_array_equal(np.asarray(m2.boundaries),
+                                  np.asarray(m.boundaries))
+    np.testing.assert_array_equal(np.asarray(m2.bin_features(x)), bins)
+
+    ens_resumed, _ = m2.append_rounds(ens_restored, bins, y, num_rounds=4)
+    assert ens_resumed.num_trees == ens_full.num_trees == 8
+    p_full = np.asarray(m.predict_margin(ens_full, bins))
+    p_resumed = np.asarray(m2.predict_margin(ens_resumed, bins))
+    np.testing.assert_allclose(p_resumed, p_full, rtol=1e-4, atol=1e-5)
+    # the appended trees route identically, not just score close
+    np.testing.assert_array_equal(np.asarray(ens_resumed.split_feat),
+                                  np.asarray(ens_full.split_feat))
+    np.testing.assert_array_equal(np.asarray(ens_resumed.split_bin),
+                                  np.asarray(ens_full.split_bin))
+
+
+def test_resume_refuses_structural_param_drift(tmp_path):
+    """resume(param=...) may retune lr etc. but must refuse to change the
+    structural fields that define the frozen binning/routing contract."""
+    from dmlc_core_tpu.bridge.checkpoint import (load_checkpoint,
+                                                 save_checkpoint)
+
+    x, y = make_data(500, 22)
+    m = GBDT(GBDTParam(num_boost_round=2, max_depth=3, num_bins=16,
+                       learning_rate=0.3), num_feature=4)
+    m.make_bins(x)
+    ens, _ = m.fit_binned(np.asarray(m.bin_features(x)), y)
+    uri = str(tmp_path / "ckpt")
+    save_checkpoint(uri, m.serving_state(ens))
+    flat = load_checkpoint(uri)
+
+    # non-structural retune is fine
+    m2, _ = GBDT.resume(flat, param=GBDTParam(
+        num_boost_round=2, max_depth=3, num_bins=16, learning_rate=0.05))
+    assert m2.param.learning_rate == pytest.approx(0.05)
+    # structural drift is a hard error, not a silent refit
+    with pytest.raises(Exception, match="structural contract"):
+        GBDT.resume(flat, param=GBDTParam(num_boost_round=2, max_depth=3,
+                                          num_bins=32))
+    with pytest.raises(Exception, match="structural contract"):
+        GBDT.resume(flat, param=GBDTParam(num_boost_round=2, max_depth=5,
+                                          num_bins=16))

@@ -451,7 +451,7 @@ def test_gbdt_watcher_hot_swaps_trained_model(tmp_path):
 
 # -- the headline chaos drill -------------------------------------------------
 
-def _version_consistency_check(payload):
+def _version_consistency_check(payload, rows=None):
     """Every prediction in a 200 must equal sigmoid(bias(version)) for the
     version the response claims served it — the probe that would catch a
     half-swapped or mixed-version answer."""

@@ -501,3 +501,66 @@ def test_http_concurrent_clients_all_answered(linear_server):
     for i, _, body in results:
         want = 1.0 / (1.0 + np.exp(-(i * w0 + b)))
         assert body["predictions"][0] == pytest.approx(want, rel=1e-4)
+
+
+# -- loadgen drift canary ------------------------------------------------------
+
+def test_loadgen_drift_bucketing_and_series():
+    """The drift canary's accounting: per-window request counts and mean
+    predictions, sorted, empty windows absent (docs/serving.md)."""
+    from dmlc_core_tpu.serve.loadgen import _mean_prediction, _Recorder
+
+    # scalar and softmax-row predictions flatten to one mean; junk skipped
+    assert _mean_prediction([0.25, 0.75]) == pytest.approx(0.5)
+    assert _mean_prediction([[0.2, 0.8], [0.4, 0.6]]) == pytest.approx(0.5)
+    assert _mean_prediction(["oops", None]) is None
+    assert _mean_prediction([]) is None
+
+    rec = _Recorder()
+    rec.record_drift(0, 0.2)
+    rec.record_drift(0, 0.4)
+    rec.record_drift(2, 0.9)           # window 1 empty: not emitted
+    series = rec.drift_series(1.5)
+    assert series == [
+        {"window": 0, "t_s": 0.0, "n": 2, "mean_prediction": 0.3},
+        {"window": 2, "t_s": 3.0, "n": 1, "mean_prediction": 0.9},
+    ]
+
+
+def test_loadgen_report_carries_drift_and_response_check(linear_server):
+    """run_load end to end: the report's drift block covers every ok
+    response bucketed by scheduled time, and a failing response_check
+    turns would-be oks into ``invalid`` (the half-swap detector)."""
+    from dmlc_core_tpu.serve.loadgen import run_load
+
+    report = run_load(linear_server.url, qps=40, duration_s=1.0,
+                      num_feature=4, seed=3, timeout_s=10.0,
+                      drift_window_s=0.25)
+    counts = report["counts"]
+    assert counts["crashed"] == 0 and counts["ok"] > 0
+    drift = report["drift"]
+    assert drift["window_s"] == pytest.approx(0.25)
+    series = drift["series"]
+    assert series, "ok traffic must produce drift windows"
+    assert sum(w["n"] for w in series) == counts["ok"]
+    assert [w["window"] for w in series] == sorted(
+        {w["window"] for w in series})
+    for w in series:
+        assert w["n"] >= 1 and np.isfinite(w["mean_prediction"])
+        assert w["t_s"] == pytest.approx(w["window"] * 0.25)
+
+    # the check sees (payload, rows): reject everything -> all invalid,
+    # nothing recorded as drift (wrong scores must not pollute the canary)
+    seen_rows = []
+
+    def reject(payload, rows):
+        seen_rows.append((payload["num_rows"], len(rows)))
+        return False
+
+    report2 = run_load(linear_server.url, qps=30, duration_s=0.5,
+                       num_feature=4, seed=4, timeout_s=10.0,
+                       response_check=reject)
+    assert report2["counts"]["invalid"] > 0
+    assert report2["counts"]["ok"] == 0
+    assert report2["drift"]["series"] == []
+    assert seen_rows and all(n == len_rows for n, len_rows in seen_rows)
