@@ -50,6 +50,25 @@ mismatch), and the gate demands ``crashed == 0``, ``invalid == 0``,
 >= 2 completed swaps, >= 1 kill survived with correct resume provenance,
 >= 1 rejected publish, >= 1 quarantined batch, and the scoring-drift
 canary rising with the shifted distribution.
+
+    python benchmarks/bench_serving.py router [--out router.json]
+        [--fault-plan benchmarks/router_fault_plan.json | none]
+        [--replicas 3] [--qps 50] [--duration 12] [--roll-duration 30]
+
+``router`` is the multi-replica chaos drill (docs/serving.md
+"Multi-replica tier"): a ReplicaFleet of real scoring subprocesses
+behind an in-process RouterServer, four storms in sequence —
+(1) **kill**: SIGKILL one replica mid-storm while the committed plan
+also resets router→replica connects; availability during the kill
+window must stay >= 99.5% and the supervisor must relaunch the corpse;
+(2) **hedge**: replica 0 loads the plan's ``serve.request`` delay rule
+(the straggler) and the same fleet is driven twice — hedging OFF then
+ON; the hedged p99 must beat the unhedged p99 and no hedge may ever be
+double-counted (loadgen ``accounting``); (3) **saturate**: tiny replica
+queues at double qps until every replica sheds, proving the router's
+own structured 503 (``all_saturated``, with Retry-After); (4)
+**rolling**: ``fleet.rolling_restart()`` drains and restarts every
+replica under load — ``crashed == 0`` throughout is the gate.
 """
 
 import argparse
@@ -66,6 +85,8 @@ LIFECYCLE_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "lifecycle_fault_plan.json")
 CONTINUOUS_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "continuous_fault_plan.json")
+ROUTER_PLAN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "router_fault_plan.json")
 NUM_FEATURE = 16
 
 
@@ -643,6 +664,322 @@ def run_continuous(args) -> int:
     return 0 if not failures else 1
 
 
+def run_router(args) -> int:
+    import math
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from dmlc_core_tpu import fault, telemetry
+    from dmlc_core_tpu.bridge.checkpoint import CheckpointManager
+    from dmlc_core_tpu.serve.fleet import ReplicaFleet
+    from dmlc_core_tpu.serve.loadgen import OUTCOMES, run_load
+    from dmlc_core_tpu.serve.router import RouterServer
+
+    telemetry.enable()
+    plan_path = args.fault_plan
+    plan_active = plan_path.lower() != "none"
+    if plan_active:
+        with open(plan_path, encoding="utf-8") as f:
+            fault.configure(f.read())
+
+    def counter(name, **labels):
+        """Sum of a dmlc counter's children whose labels match."""
+        total = 0.0
+        for fam in telemetry.get_registry().families():
+            if fam.name != name:
+                continue
+            for key, child in fam.samples():
+                kd = dict(key)
+                if all(kd.get(k) == v for k, v in labels.items()):
+                    total += child.value
+        return total
+
+    # every replica serves the SAME w=0 logistic checkpoint: each
+    # prediction must equal sigmoid(bias) exactly, and CLI-launched
+    # replicas register their slot at version 0 — any other claim, or
+    # any other prediction value, is cross-replica skew -> `invalid`
+    ckpt_dir = tempfile.mkdtemp(prefix="router-ckpt-")
+    mgr = CheckpointManager(ckpt_dir, keep=2)
+    mgr.save(1, {"w": np.zeros(NUM_FEATURE, np.float32),
+                 "b": np.float32(_bias_for(1))}, async_=False)
+    want = 1.0 / (1.0 + math.exp(-_bias_for(1)))
+
+    def check(payload, rows=None):
+        if payload.get("version") != 0:
+            return False
+        return all(abs(p - want) < 1e-5 for p in payload["predictions"])
+
+    log_root = tempfile.mkdtemp(prefix="router-logs-")
+
+    def make_fleet(tag, **overrides):
+        kw = dict(model="linear", num_feature=NUM_FEATURE, seed=0,
+                  checkpoint=mgr.step_uri(1), max_batch=32,
+                  max_delay_ms=2.0, request_timeout_s=8.0,
+                  log_dir=os.path.join(log_root, tag), auto_restart=True)
+        kw.update(overrides)
+        return ReplicaFleet(args.replicas, **kw)
+
+    def make_router(fleet, **overrides):
+        kw = dict(probe_interval_s=0.2, try_timeout_s=3.0,
+                  request_timeout_s=8.0)
+        kw.update(overrides)
+        router = RouterServer(fleet.urls, **kw)
+        router.start()
+        return router
+
+    window_s = 0.5
+    report = {"fault_plan": plan_path if plan_active else None,
+              "host": _host_info(), "replicas": args.replicas,
+              "checkpoint_dir": ckpt_dir, "replica_logs": log_root,
+              "phases": {}}
+    failures = []
+
+    def gate_counts(phase, load, *, want_ok=True):
+        c = load["counts"]
+        if c["crashed"] or c["error"]:
+            failures.append(
+                f"{phase}: {c['crashed']} crashed + {c['error']} "
+                "unstructured errors — the degradation contract is broken")
+        if c["invalid"]:
+            failures.append(
+                f"{phase}: {c['invalid']} responses with skewed "
+                "predictions — a replica answered with the wrong params")
+        if want_ok and c["ok"] == 0:
+            failures.append(f"{phase}: no request succeeded")
+        if not load["accounting"]["ok"]:
+            failures.append(
+                f"{phase}: {load['accounting']['recorded']} outcomes "
+                f"recorded for {load['accounting']['requests']} requests "
+                "— a hedged response was double-delivered")
+
+    # ---- phase 1: SIGKILL one replica mid-storm -------------------------
+    # the committed plan's connect-reset rule also fires here: the router
+    # must absorb both the corpse and the resets with failover retries
+    kill_at = max(2.0, args.duration * 0.35)
+    print(f"router/kill: {args.replicas} replicas, SIGKILL r0 at "
+          f"t={kill_at:.1f}s of {args.duration:.0f}s...", flush=True)
+    fleet = make_fleet("kill")
+    fleet.start()
+    router = make_router(fleet)
+    try:
+        killer = threading.Timer(kill_at, fleet.kill, args=(0,))
+        killer.daemon = True
+        killer.start()
+        load = run_load(router.url, qps=args.qps,
+                        duration_s=args.duration, num_feature=NUM_FEATURE,
+                        rows_per_request=2, seed=19, timeout_s=8.0,
+                        response_check=check, drift_window_s=window_s)
+        killer.join(10.0)
+        time.sleep(2.0)  # let hedge losers finish: their spans must close
+        phase = {"load": load, "kill_at_s": kill_at,
+                 "launches": fleet.launches(), "router": router.stats()}
+    finally:
+        router.close()
+        fleet.close()
+    # availability = structured-answer fraction over the scheduled-time
+    # windows that bracket the kill (shed/timeout/rejected all count as
+    # answered: the contract is "nothing vanished", not "nothing failed")
+    kill_lo, kill_hi = kill_at - window_s, kill_at + 2.0
+    windows = [w for w in load["outcome_windows"]["series"]
+               if kill_lo <= w["t_s"] <= kill_hi]
+    total = sum(sum(w[k] for k in OUTCOMES) for w in windows)
+    unanswered = sum(w["crashed"] + w["error"] + w["invalid"]
+                     for w in windows)
+    availability = (1.0 - unanswered / total) if total else None
+    phase["kill_window"] = {
+        "t_lo_s": kill_lo, "t_hi_s": kill_hi, "requests": total,
+        "unanswered": unanswered,
+        "availability": None if availability is None
+        else round(availability, 5)}
+    report["phases"]["kill"] = phase
+    gate_counts("kill", load)
+    if availability is None or availability < 0.995:
+        failures.append(
+            f"kill: availability {availability} < 99.5% during the kill "
+            f"window [{kill_lo:.1f}s, {kill_hi:.1f}s]")
+    if phase["launches"][0] < 2:
+        failures.append("kill: the killed replica was never relaunched")
+
+    # ---- phase 2: straggler replica, hedging OFF then ON ----------------
+    # replica 0 loads the committed plan itself: its serve.request delay
+    # rule makes it the straggler (the driver holds the same plan but has
+    # no serve.request site, so the rule is inert here)
+    straggler_env = ({0: {"DMLC_FAULT_PLAN": "@" + os.path.abspath(
+        plan_path)}} if plan_active else None)
+    print("router/hedge: straggler on r0, unhedged vs hedged...",
+          flush=True)
+    fleet = make_fleet("hedge", per_replica_env=straggler_env)
+    fleet.start()
+    hedge_phase = {}
+    try:
+        for mode, hedged in (("unhedged", False), ("hedged", True)):
+            fired0 = counter("dmlc_router_hedges_total", outcome="fired")
+            won0 = counter("dmlc_router_hedges_total",
+                           outcome="hedge_won")
+            router = make_router(fleet, hedge=hedged)
+            try:
+                load = run_load(
+                    router.url, qps=args.qps,
+                    duration_s=max(6.0, args.duration * 0.8),
+                    num_feature=NUM_FEATURE, rows_per_request=2,
+                    seed=23 if hedged else 29, timeout_s=8.0,
+                    response_check=check, drift_window_s=window_s)
+                time.sleep(2.0)
+                hedge_phase[mode] = {
+                    "load": load,
+                    "hedges_fired": counter("dmlc_router_hedges_total",
+                                            outcome="fired") - fired0,
+                    "hedges_won": counter("dmlc_router_hedges_total",
+                                          outcome="hedge_won") - won0,
+                    "hedge_delay_s": router.health()["hedge_delay_s"],
+                }
+            finally:
+                router.close()
+    finally:
+        fleet.close()
+    report["phases"]["hedge"] = hedge_phase
+    for mode in ("unhedged", "hedged"):
+        gate_counts(f"hedge/{mode}", hedge_phase[mode]["load"])
+    if hedge_phase["unhedged"]["hedges_fired"]:
+        failures.append("hedge: hedges fired with hedging disabled")
+    if plan_active:
+        un_p99 = hedge_phase["unhedged"]["load"]["latency_ms"]["p99"]
+        he_p99 = hedge_phase["hedged"]["load"]["latency_ms"]["p99"]
+        if un_p99 is None or he_p99 is None or he_p99 >= un_p99:
+            failures.append(
+                f"hedge: hedged p99 {he_p99}ms did not beat the "
+                f"straggler's unhedged p99 {un_p99}ms")
+        if hedge_phase["hedged"]["hedges_fired"] == 0:
+            failures.append("hedge: straggler active but no hedge fired")
+
+    # ---- phase 3: saturate every replica --------------------------------
+    # tiny per-replica queues at double qps, with EVERY replica loading
+    # the plan: its serve.predict delay rule (matched to this fleet's
+    # slot name) holds each batch's admission bytes, so the 2 KiB queues
+    # genuinely fill.  Once every replica has answered 503, the router
+    # must shed from its OWN admission view — a structured router 503
+    # with Retry-After, not a forward
+    print("router/saturate: tiny queues at double qps...", flush=True)
+    plan_env = ({i: {"DMLC_FAULT_PLAN": "@" + os.path.abspath(plan_path)}
+                 for i in range(args.replicas)} if plan_active else None)
+    fleet = make_fleet("saturate", model_name="saturated", max_batch=8,
+                       max_delay_ms=120.0, max_queue_bytes=2048,
+                       per_replica_env=plan_env)
+    fleet.start()
+    shed0 = counter("dmlc_router_shed_total", reason="all_saturated")
+    router = make_router(fleet, hedge=False)
+    try:
+        load = run_load(router.url, qps=args.qps * 2,
+                        duration_s=max(5.0, args.duration * 0.6),
+                        num_feature=NUM_FEATURE, rows_per_request=4,
+                        seed=31, timeout_s=8.0, response_check=check,
+                        drift_window_s=window_s)
+        time.sleep(2.0)
+        phase = {"load": load,
+                 "router_all_saturated_sheds": counter(
+                     "dmlc_router_shed_total",
+                     reason="all_saturated") - shed0}
+    finally:
+        router.close()
+        fleet.close()
+    report["phases"]["saturate"] = phase
+    gate_counts("saturate", load, want_ok=False)
+    if plan_active:
+        if load["counts"]["shed"] == 0:
+            failures.append("saturate: nothing was shed at double qps "
+                            "against 2 KiB queues")
+        if phase["router_all_saturated_sheds"] < 1:
+            failures.append(
+                "saturate: the router never shed from its own admission "
+                "view (no all_saturated 503) — every shed was a forward")
+
+    # ---- phase 4: rolling restart of the whole fleet --------------------
+    print("router/rolling: drain+restart every replica under load...",
+          flush=True)
+    fleet = make_fleet("rolling")
+    fleet.start()
+    router = make_router(fleet)
+    roll = {}
+
+    def roller():
+        try:
+            time.sleep(1.5)
+            fleet.rolling_restart(settle_s=0.6)
+            roll["completed"] = True
+        except Exception as e:
+            roll["error"] = repr(e)
+
+    try:
+        t = threading.Thread(target=roller)
+        t.start()
+        load = run_load(router.url, qps=args.qps,
+                        duration_s=args.roll_duration,
+                        num_feature=NUM_FEATURE, rows_per_request=2,
+                        seed=37, timeout_s=8.0, response_check=check,
+                        drift_window_s=window_s)
+        t.join(120.0)
+        # longer settle than the other phases: this is the last storm, so
+        # any forward attempt still in flight when the DRIVER exits would
+        # orphan the replica span it parented
+        time.sleep(3.5)
+        phase = {"load": load, "launches": fleet.launches(),
+                 "rolling_completed": bool(roll.get("completed")),
+                 "rolling_error": roll.get("error")}
+    finally:
+        router.close()
+        fleet.close()
+    report["phases"]["rolling"] = phase
+    gate_counts("rolling", load)
+    if not phase["rolling_completed"]:
+        failures.append(
+            f"rolling: restart never completed ({roll.get('error')})")
+    short = [i for i, n in enumerate(phase["launches"]) if n < 2]
+    if short:
+        failures.append(
+            f"rolling: replicas {short} were never restarted "
+            f"(launches={phase['launches']})")
+
+    fired = [(site, kind) for site, kind, _ in fault.fires()]
+    report["faults_fired"] = sorted(set(fired))
+    if plan_active and ("serve.router.forward", "reset") not in fired:
+        failures.append("the connect-reset fault never fired at "
+                        "serve.router.forward")
+    report["slo_ok"] = not failures
+    report["slo_failures"] = failures
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    print(json.dumps({k: v for k, v in report.items()
+                      if k not in ("checkpoint_dir", "replica_logs")},
+                     indent=1, sort_keys=True))
+    kw = report["phases"]["kill"]["kill_window"]
+    print(f"\nrouter chaos: availability "
+          f"{kw['availability']} during the kill window, "
+          f"{hedge_phase['hedged']['hedges_fired']:.0f} hedges fired "
+          f"({hedge_phase['hedged']['hedges_won']:.0f} won), "
+          f"{report['phases']['saturate']['router_all_saturated_sheds']:.0f}"
+          f" router sheds, launches {report['phases']['rolling']['launches']}")
+    rows = [("kill", report["phases"]["kill"]["load"]),
+            ("unhedged", hedge_phase["unhedged"]["load"]),
+            ("hedged", hedge_phase["hedged"]["load"]),
+            ("saturate", report["phases"]["saturate"]["load"]),
+            ("rolling", report["phases"]["rolling"]["load"])]
+    print(f"{'phase':<9} {'ok':>5} {'shed':>5} {'rejec':>5} {'inval':>5} "
+          f"{'crash':>5} {'p50ms':>8} {'p99ms':>8}")
+    for name, ld in rows:
+        c, lat = ld["counts"], ld["latency_ms"]
+        print(f"{name:<9} {c['ok']:>5} {c['shed']:>5} {c['rejected']:>5} "
+              f"{c['invalid']:>5} {c['crashed']:>5} "
+              f"{str(lat['p50']):>8} {str(lat['p99']):>8}")
+    for msg in failures:
+        print(f"ROUTER FAILURE: {msg}")
+    return 0 if not failures else 1
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -681,6 +1018,20 @@ def main(argv=None) -> int:
                          "them; one is poisoned)")
     ct.add_argument("--qps", type=float, default=40.0)
     ct.add_argument("--duration", type=float, default=75.0)
+    rt = sub.add_parser("router",
+                        help="multi-replica chaos drill: kill / hedge / "
+                             "saturate / rolling restart")
+    rt.add_argument("--out", default=None)
+    rt.add_argument("--fault-plan", default=ROUTER_PLAN,
+                    help="plan JSON path, or 'none' to disable injection")
+    rt.add_argument("--replicas", type=int, default=3)
+    rt.add_argument("--qps", type=float, default=50.0)
+    rt.add_argument("--duration", type=float, default=12.0,
+                    help="kill-phase seconds (hedge/saturate phases scale "
+                         "from it)")
+    rt.add_argument("--roll-duration", type=float, default=30.0,
+                    help="rolling-restart phase seconds (must cover 3 "
+                         "drain+relaunch+warmup cycles)")
     args = p.parse_args(argv)
     if args.cmd == "smoke":
         return run_smoke(args)
@@ -688,6 +1039,8 @@ def main(argv=None) -> int:
         return run_lifecycle(args)
     if args.cmd == "continuous":
         return run_continuous(args)
+    if args.cmd == "router":
+        return run_router(args)
     return run_knee(args)
 
 

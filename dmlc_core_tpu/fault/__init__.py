@@ -30,6 +30,8 @@ Injection sites (see :data:`SITES`):
 - ``serve.swap``           — the model-lifecycle watcher's
   watch/validate/warmup/swap stages (hot-swap chaos: a rejected candidate
   must leave previous-good serving);
+- ``serve.router.forward`` — the multi-replica router's forward path, per
+  attempt (replica-death, slow-link, and injected-response chaos);
 - ``train.ingest`` / ``train.round`` / ``train.publish`` — the continuous
   trainer daemon's batch fetch, boosting round, and checkpoint publish
   (kill-mid-round and torn-publish chaos: docs/training.md).
@@ -122,9 +124,11 @@ SITES: Dict[str, str] = {
         "and admission control starts shedding (503 + Retry-After)"),
     "serve.predict": (
         "once per assembled batch before the model call (ctx: "
-        "model=<family>, rows=<n>); 'error' models a killed predict "
-        "worker — that batch's requests fail with a structured 503 "
-        "predict_failed and the batcher continues"),
+        "model=<family>, slot=<slot name>, rows=<n>); 'error' models a "
+        "killed predict worker — that batch's requests fail with a "
+        "structured 503 predict_failed and the batcher continues; a "
+        "'delay' holds the batch's admission bytes, so queues genuinely "
+        "back up (the router chaos drill saturates replicas this way)"),
     "serve.swap": (
         "model-lifecycle watcher, once per stage of each hot-swap cycle "
         "(ctx: model=<slot>, stage=watch|validate|warmup|swap); "
@@ -132,6 +136,16 @@ SITES: Dict[str, str] = {
         "— previous-good keeps serving; 'stall' during swap delays the "
         "pointer flip but can never tear it (docs/serving.md \"Model "
         "lifecycle\")"),
+    "serve.router.forward": (
+        "multi-replica router, once per forward attempt before the replica "
+        "connection is opened (ctx: replica=<name>, attempt=<n>, "
+        "tag=primary|hedge); 'reset' models a replica dying at connect "
+        "time (zero response bytes read — the router retries on another "
+        "replica), 'stall'/'delay' model a slow replica link (hedging "
+        "territory), 'error' a router-side forwarding bug (structured "
+        "503 replica_failed, never a dropped connection), and "
+        "'http_status' REPLACES the replica round-trip with an injected "
+        "response (docs/serving.md \"Multi-replica tier\")"),
     "train.ingest": (
         "continuous trainer, once per batch fetch before the source is "
         "read (ctx: cursor=<position>, incarnation=<n>); 'error'/'reset' "

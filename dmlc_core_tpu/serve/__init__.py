@@ -28,6 +28,14 @@ subsystems earlier PRs shipped:
   requests — the closed train→serve loop, scoring through the same uint8
   binned wire + ``HostBinner`` edges the model trained on.
 
+- **multi-replica tier** (:mod:`.router`, :mod:`.fleet`,
+  ``python -m dmlc_core_tpu.serve --replicas N``) — N replica processes
+  on fixed ports behind a health-checked router: passive+active health
+  state machine with half-open recovery, connect-level-only failover
+  retries, p95-tracked request hedging, shared admission state from the
+  enriched ``/healthz``, and zero-downtime rolling restarts via replica
+  drain (docs/serving.md "Multi-replica tier").
+
 See docs/serving.md for the architecture, the knee-curve methodology, and
 every knob.
 """
@@ -35,12 +43,15 @@ every knob.
 from dmlc_core_tpu.serve.admission import AdmissionController  # noqa: F401
 from dmlc_core_tpu.serve.errors import (BadRequest, Overloaded,  # noqa: F401
                                         PredictFailed, RequestTimeout,
-                                        ServeError, UnknownModel)
+                                        ServeError, UnknownModel,
+                                        UpstreamFailed)
+from dmlc_core_tpu.serve.fleet import ReplicaFleet  # noqa: F401
 from dmlc_core_tpu.serve.lifecycle import (CheckpointWatcher,  # noqa: F401
                                            runtime_builder)
 from dmlc_core_tpu.serve.model_runtime import (GBDTRuntime,  # noqa: F401
                                                LinearRuntime, MLPRuntime,
                                                ModelRuntime, build_runtime)
 from dmlc_core_tpu.serve.registry import ModelRegistry, ModelSlot  # noqa: F401
+from dmlc_core_tpu.serve.router import Replica, RouterServer  # noqa: F401
 from dmlc_core_tpu.serve.scheduler import MicroBatcher, batch_buckets  # noqa: F401
 from dmlc_core_tpu.serve.server import ScoringServer  # noqa: F401

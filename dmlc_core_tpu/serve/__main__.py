@@ -66,7 +66,72 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--watch-interval-s", type=float, default=None,
                    help="watcher poll interval (default: "
                         "DMLC_SERVE_WATCH_S or 2.0)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="run N replica processes behind a health-checked "
+                        "router with failover + hedging (--port binds the "
+                        "ROUTER; replicas take ephemeral ports — "
+                        "docs/serving.md \"Multi-replica tier\")")
+    p.add_argument("--no-hedge", action="store_true",
+                   help="disable request hedging in the router "
+                        "(--replicas > 1 only)")
     return p
+
+
+def _run_replicated(args: argparse.Namespace) -> int:
+    """--replicas N: a ReplicaFleet of scoring processes behind a
+    RouterServer; SIGTERM rolls everything down cleanly (router first —
+    stop routing, then drain the replicas)."""
+    from dmlc_core_tpu.serve.fleet import ReplicaFleet
+    from dmlc_core_tpu.serve.router import RouterServer
+
+    telemetry.enable()
+    name = args.model_name or args.model
+    extra_args: List[str] = []
+    if args.no_warmup:
+        extra_args.append("--no-warmup")
+    if args.watch_dir:
+        extra_args += ["--watch-dir", args.watch_dir]
+        if args.watch_interval_s is not None:
+            extra_args += ["--watch-interval-s",
+                           str(args.watch_interval_s)]
+    fleet = ReplicaFleet(
+        args.replicas, model=args.model, num_feature=args.num_feature,
+        seed=args.seed, host=args.host, max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        max_queue_bytes=args.max_queue_bytes,
+        request_timeout_s=args.request_timeout_s,
+        checkpoint=args.checkpoint, model_name=args.model_name,
+        warmup=not args.no_warmup, extra_args=extra_args)
+    stop = threading.Event()
+
+    def _signal(signum, frame):  # noqa: ARG001 (signal contract)
+        stop.set()
+
+    signal.signal(signal.SIGINT, _signal)
+    signal.signal(signal.SIGTERM, _signal)
+    fleet.start()
+    try:
+        router = RouterServer(
+            fleet.urls, host=args.host, port=args.port,
+            hedge=False if args.no_hedge else None,
+            # the router outlives one replica try-chain: per-try deadline
+            # + retries must fit inside its own request deadline
+            request_timeout_s=args.request_timeout_s + 5.0)
+        router.start()
+    except Exception:
+        fleet.close()
+        raise
+    try:
+        # same stable prefix as single-process mode: headless launchers
+        # scrape "serving <name> on <url>" for the bound URL
+        print(f"serving {name} on {router.url} "
+              f"(replicas={args.replicas}, ctrl-c to stop)")
+        stop.wait()
+    finally:
+        router.close()
+        fleet.close()
+    print("serve: shut down cleanly")
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -76,6 +141,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     from dmlc_core_tpu.utils.platform import sync_platform_from_env
 
     sync_platform_from_env()
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
+    if args.replicas > 1:
+        return _run_replicated(args)
     # a server without metrics cannot state its SLOs: collection on
     # unconditionally (flushing still needs DMLC_TELEMETRY_DIR)
     telemetry.enable()
@@ -105,19 +174,23 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     signal.signal(signal.SIGINT, _signal)
     signal.signal(signal.SIGTERM, _signal)
-    with server:
+    server.start()
+    if watcher is not None:
+        watcher.start()
+    try:
+        # keep "serving <name> on <url>" as the stable prefix: headless
+        # launchers (tests/test_trace_e2e.py) scrape this line for the
+        # bound URL
+        print(f"serving {name} on {server.url} "
+              f"(model={runtime.name}, ctrl-c to stop)")
+        stop.wait()
+    finally:
         if watcher is not None:
-            watcher.start()
-        try:
-            # keep "serving <name> on <url>" as the stable prefix: headless
-            # launchers (tests/test_trace_e2e.py) scrape this line for the
-            # bound URL
-            print(f"serving {name} on {server.url} "
-                  f"(model={runtime.name}, ctrl-c to stop)")
-            stop.wait()
-        finally:
-            if watcher is not None:
-                watcher.close()
+            watcher.close()
+        # graceful drain (the rolling-restart contract): /healthz flips
+        # to "draining", in-flight requests finish, THEN the listener
+        # closes — a SIGTERM mid-storm must record zero client crashes
+        server.drain()
     print("serve: shut down cleanly")
     return 0
 

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Optional
+from typing import Any, Dict, Optional
 
 from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.serve.errors import BadRequest, Overloaded
@@ -37,6 +37,10 @@ RETRY_AFTER_FLOOR = 1.0
 RETRY_AFTER_CAP = 30.0
 
 _EWMA_ALPHA = 0.3  # drain-rate smoothing: responsive but not twitchy
+# shed-fraction smoothing: slower than the drain rate on purpose — the
+# router reads this from /healthz as "how hot has this slot been lately",
+# and a single admitted request must not erase a shedding episode
+_SHED_EWMA_ALPHA = 0.05
 # minimum sampling window for a drain-rate observation: releases landing
 # microseconds apart (batches completing back-to-back) would otherwise
 # produce absurd instantaneous rates that swamp the EWMA
@@ -79,6 +83,7 @@ class AdmissionController:
         self._drain_rate: Optional[float] = None  # EWMA bytes/second
         self._window_start: Optional[float] = None
         self._window_bytes = 0  # drained since _window_start
+        self._shed_ewma = 0.0   # EWMA of shed-vs-admit decisions in [0, 1]
 
     @property
     def queued_bytes(self) -> int:
@@ -105,8 +110,10 @@ class AdmissionController:
             if self._queued + nbytes > self.max_queue_bytes:
                 retry = self._retry_after_locked(nbytes)
                 queued = self._queued
+                self._shed_ewma += _SHED_EWMA_ALPHA * (1.0 - self._shed_ewma)
             else:
                 self._queued += nbytes
+                self._shed_ewma -= _SHED_EWMA_ALPHA * self._shed_ewma
                 telemetry.gauge_set("dmlc_serve_queue_bytes", self._queued,
                                     model=self.name)
                 return
@@ -148,6 +155,17 @@ class AdmissionController:
                     + (1 - _EWMA_ALPHA) * self._drain_rate)
                 self._window_start = now
                 self._window_bytes = 0
+
+    def describe(self) -> Dict[str, Any]:
+        """The admission snapshot ``/healthz`` publishes per model slot —
+        what the multi-replica router routes on (least-loaded by queue
+        fraction) instead of bare liveness."""
+        with self._lock:
+            return {"queue_bytes": self._queued,
+                    "max_queue_bytes": self.max_queue_bytes,
+                    "drain_rate_bps": (round(self._drain_rate, 1)
+                                       if self._drain_rate else None),
+                    "shed_ewma": round(self._shed_ewma, 6)}
 
     def _retry_after_locked(self, nbytes: int) -> float:
         """Seconds until ``nbytes`` plausibly fits, from the drain EWMA.

@@ -21,7 +21,7 @@ import json
 from typing import Any, Dict, Optional
 
 __all__ = ["ServeError", "BadRequest", "Overloaded", "PredictFailed",
-           "RequestTimeout", "UnknownModel"]
+           "RequestTimeout", "UnknownModel", "UpstreamFailed"]
 
 
 class ServeError(Exception):
@@ -94,6 +94,21 @@ class RequestTimeout(ServeError):
 
     status = 504
     code = "timeout"
+
+
+class UpstreamFailed(ServeError):
+    """The router forwarded this request to a replica that failed after
+    response bytes were read (or after the no-replay point) — the body is
+    never replayed, so the client gets a structured 503 shed and retries
+    itself (scoring is idempotent end-to-end, the router just refuses to
+    guess whether a half-answered request was scored)."""
+
+    status = 503
+    code = "replica_failed"
+
+    def __init__(self, message: str, *, retry_after: float = 1.0,
+                 details: Optional[Dict[str, Any]] = None):
+        super().__init__(message, retry_after=retry_after, details=details)
 
 
 class UnknownModel(ServeError):

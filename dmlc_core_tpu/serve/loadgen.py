@@ -14,9 +14,13 @@ Outcome taxonomy (the SLO vocabulary of docs/serving.md):
 ``ok``         200 with a parseable predictions body of the right length
 ``shed``       structured 503 (admission, predict-failure, injected storm)
 ``timeout``    structured 504, or the client-side deadline elapsed
-``rejected``   structured 4xx (the load was malformed — a client bug)
+``rejected``   structured 4xx (the load was malformed — a client bug), or
+               connection **refused**: nothing was listening, which in the
+               multi-replica era means a restart window (the OS said "not
+               here" before any bytes moved — cleanly retryable, nothing
+               was lost mid-flight)
 ``error``      any other structured 5xx
-``crashed``    no structured answer at all: connection refused/reset,
+``crashed``    no structured answer at all: connection reset mid-request,
                truncated body, unparseable response
 ``invalid``    200 whose body fails the caller's ``response_check`` —
                the answer arrived but is WRONG (the hot-swap drill uses
@@ -100,15 +104,29 @@ class _Recorder:
         # drift canary: window index -> [n_requests, sum of per-request
         # mean predictions] over ok responses only
         self.drift: Dict[int, List[float]] = {}
+        # per-window outcome counts, keyed by SCHEDULED arrival window —
+        # what availability-during-a-kill-window gates are computed from
+        self.windows: Dict[int, Dict[str, int]] = {}
 
     def record(self, outcome: str, latency_s: float,
-               status: Optional[int], trace_id: str) -> None:
+               status: Optional[int], trace_id: str,
+               window: Optional[int] = None) -> None:
         with self.lock:
             self.counts[outcome] += 1
             if status is not None:
                 key = str(status)
                 self.statuses[key] = self.statuses.get(key, 0) + 1
             self.samples.append((latency_s, trace_id, outcome, status))
+            if window is not None:
+                acc = self.windows.setdefault(window,
+                                              {k: 0 for k in OUTCOMES})
+                acc[outcome] += 1
+
+    def window_series(self, window_s: float) -> List[Dict[str, Any]]:
+        with self.lock:
+            items = sorted((w, dict(c)) for w, c in self.windows.items())
+        return [dict({"window": w, "t_s": round(w * window_s, 3)}, **c)
+                for w, c in items]
 
     def record_drift(self, window: int, mean_prediction: float) -> None:
         with self.lock:
@@ -193,9 +211,16 @@ def _issue(url: str, path: str, body: bytes, timeout_s: float,
     except urllib.error.URLError as e:
         # urllib wraps connect-phase deadline expiry in URLError: that is
         # the client's deadline, not a server crash
-        if isinstance(getattr(e, "reason", None), TimeoutError):
+        reason = getattr(e, "reason", None)
+        if isinstance(reason, TimeoutError):
             return "timeout", None, None
+        if isinstance(reason, ConnectionRefusedError):
+            # nothing listening on the port: a replica/router restart
+            # window, not a dropped in-flight request
+            return "rejected", None, None
         return "crashed", None, None
+    except ConnectionRefusedError:
+        return "rejected", None, None
     except (ConnectionError, OSError):
         return "crashed", None, None
     except Exception:
@@ -255,7 +280,8 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         telemetry.record_span("client.request", t0, t1,
                               trace=(trace_id, span_id, None),
                               outcome=outcome, status=status or 0)
-        rec.record(outcome, t1 - start - scheduled_at, status, trace_id)
+        rec.record(outcome, t1 - start - scheduled_at, status, trace_id,
+                   window=int(scheduled_at // drift_window_s))
         if mean_pred is not None:
             # bucket by SCHEDULED time: the canary plots what the model
             # answered for traffic offered at t, not when it got around
@@ -306,6 +332,21 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         "drift": {
             "window_s": drift_window_s,
             "series": rec.drift_series(drift_window_s),
+        },
+        # outcome counts bucketed by scheduled arrival window: what a
+        # "availability >= X% during the kill window" gate reads
+        "outcome_windows": {
+            "window_s": drift_window_s,
+            "series": rec.window_series(drift_window_s),
+        },
+        # exactly-once accounting: one recorded outcome per issued
+        # request.  A hedged router response that somehow got delivered
+        # twice (double-counted) would make recorded > requests and flip
+        # ok to false — the chaos drill gates on it.
+        "accounting": {
+            "requests": n,
+            "recorded": sum(rec.counts.values()),
+            "ok": sum(rec.counts.values()) == n,
         },
     }
     server_stats = _fetch_stats(url, timeout_s)

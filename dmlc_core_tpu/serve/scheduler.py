@@ -406,7 +406,8 @@ class MicroBatcher:
                 for item in batch:
                     x[ofs:ofs + item.rows.shape[0]] = item.rows
                     ofs += item.rows.shape[0]
-                fault.inject("serve.predict", model=runtime.name, rows=n)
+                fault.inject("serve.predict", model=runtime.name,
+                             slot=self.name, rows=n)
                 t0 = clock.monotonic()
                 handle = runtime.predict_async(x)
         except Exception as exc:

@@ -31,7 +31,9 @@ thread-per-request predict path.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
@@ -162,12 +164,21 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/healthz":
                 default = app.registry.get()
+                # the enriched liveness contract: "status" keeps the plain
+                # ok/draining probe semantics existing checks rely on,
+                # "admission" adds the per-model load state the router
+                # routes on (queue-bytes, budget, shed EWMA)
                 self._respond_json(200, {
-                    "status": "ok", "model": default.family,
+                    "status": "draining" if app.draining else "ok",
+                    "model": default.family,
                     "version": default.version,
                     "num_feature": default.num_feature,
                     "max_batch": default.batcher.max_batch,
                     "models": app.registry.describe(),
+                    "admission": {
+                        name: app.registry.get(name).admission.describe()
+                        for name in app.registry.names()},
+                    "in_flight": app.in_flight,
                     "uptime_s": round(clock.monotonic() - app.started_at,
                                       3)})
             elif self.path == "/metrics":
@@ -184,7 +195,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond_error(exc)
 
     def do_POST(self) -> None:  # noqa: N802 (http.server contract)
+        # the in-flight odometer brackets the WHOLE request so a drain
+        # (SIGTERM rolling restart) only exits once every admitted
+        # request has been answered — including its error envelope
         app = self.app
+        app._request_begin()
+        try:
+            self._handle_post(app)
+        finally:
+            app._request_end()
+
+    def _handle_post(self, app: "ScoringServer") -> None:
         t0 = clock.monotonic()
         status = 500
         # route first: the per-model label every request-path metric
@@ -382,7 +403,33 @@ class ScoringServer:
         self._httpd = _Server((host, port), _Handler)
         self._httpd.app = self  # type: ignore[attr-defined]
         self._serve_thread: Optional[threading.Thread] = None
+        # drain/lifecycle state: handler threads bump the in-flight
+        # odometer, the drain path and /healthz read it
+        self._state_lock = threading.Lock()
+        self._in_flight = 0
+        self._draining = False
+        self._closed = False
         self.started_at = clock.monotonic()
+
+    # -- drain bookkeeping (handler threads + the SIGTERM path) ---------------
+
+    def _request_begin(self) -> None:
+        with self._state_lock:
+            self._in_flight += 1
+
+    def _request_end(self) -> None:
+        with self._state_lock:
+            self._in_flight -= 1
+
+    @property
+    def in_flight(self) -> int:
+        with self._state_lock:
+            return self._in_flight
+
+    @property
+    def draining(self) -> bool:
+        with self._state_lock:
+            return self._draining
 
     # -- single-model compatibility views (the default slot's pieces) ---------
 
@@ -435,7 +482,48 @@ class ScoringServer:
         except Exception as exc:  # noqa: BLE001 — ferried, not swallowed
             log_warning(f"serve: listener exited abnormally: {exc!r}")
 
+    def drain(self, timeout_s: Optional[float] = None,
+              settle_s: float = 0.5) -> None:
+        """Zero-downtime shutdown: stop being a routing target, finish
+        every in-flight request, then close.
+
+        Flips ``/healthz`` to ``"draining"`` immediately (the router's
+        prober stops routing here within one probe interval), keeps
+        serving for at least ``settle_s`` (covering that notice window —
+        requests already routed our way must land, not crash), waits for
+        the in-flight odometer to hit zero, then closes.  Bounded by
+        ``timeout_s`` (default ``DMLC_SERVE_DRAIN_S``, 10s): a wedged
+        request must not turn a rolling restart into a hung deploy.
+        """
+        with self._state_lock:
+            already = self._draining
+            self._draining = True
+        if not already:
+            log_info(f"serve: draining {self.url} "
+                     f"(in_flight={self.in_flight})")
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("DMLC_SERVE_DRAIN_S", "10"))
+        start = clock.monotonic()
+        deadline = start + max(float(timeout_s), 0.0)
+        while clock.monotonic() < deadline:
+            if self.in_flight == 0 \
+                    and clock.monotonic() - start >= settle_s:
+                break
+            time.sleep(0.05)
+        leftover = self.in_flight
+        if leftover:
+            log_warning(f"serve: drain deadline ({timeout_s:g}s) hit with "
+                        f"{leftover} request(s) still in flight")
+        else:
+            log_info(f"serve: drained in "
+                     f"{clock.monotonic() - start:.2f}s, shutting down")
+        self.close()
+
     def close(self) -> None:
+        with self._state_lock:
+            if self._closed:
+                return  # drain() already closed us; __exit__ is a no-op
+            self._closed = True
         self._httpd.shutdown()
         if self._serve_thread is not None:
             self._serve_thread.join(10.0)

@@ -3615,6 +3615,37 @@ def test_real_lifecycle_is_race_clean():
         {"dmlc_core_tpu/serve/lifecycle.py": src}) == []
 
 
+def test_seeded_unlocked_saturation_stamp_in_real_router():
+    """Regression for the router's health-FSM lock discipline: the
+    shared-admission stamp is written by every forward thread that
+    relays a replica 503 and read by every _pick — stripping its only
+    locked write is exactly one unlocked shared write (failures /
+    half_open keep their locked sites, so no lockset downgrade noise)."""
+    src = _real_source("dmlc_core_tpu/serve/router.py")
+    broken = src.replace(
+        "        with self._lock:\n"
+        "            self.saturated_until = clock.monotonic() "
+        "+ retry_after_s",
+        "        self.saturated_until = clock.monotonic() "
+        "+ retry_after_s")
+    assert broken != src, "fix shape changed; update the seeding"
+    found = _races_on_sources({"dmlc_core_tpu/serve/router.py": broken})
+    assert [(f.rule, f.symbol) for f in found] == \
+        [("race-unlocked-shared-write", "Replica.saturated_until")]
+
+
+def test_real_router_is_race_clean():
+    src = _real_source("dmlc_core_tpu/serve/router.py")
+    assert _races_on_sources(
+        {"dmlc_core_tpu/serve/router.py": src}) == []
+
+
+def test_real_fleet_is_race_clean():
+    src = _real_source("dmlc_core_tpu/serve/fleet.py")
+    assert _races_on_sources(
+        {"dmlc_core_tpu/serve/fleet.py": src}) == []
+
+
 def test_seeded_unlocked_swap_in_real_registry():
     """Regression for the fixed ModelRegistry.swap races: the version/
     warmed/swapped_at stamps (and the runtime's version ride-along)

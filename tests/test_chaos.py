@@ -314,7 +314,14 @@ def test_injected_handshake_reset_then_recovery():
     fault.configure({"rules": [
         {"site": "tracker.framed.recv", "kind": "reset",
          "message": "chaos: handshake reset"}]})
-    tracker = RabitTracker("127.0.0.1", 1)
+    # Both ends of the handshake run in THIS process on the instrumented
+    # FramedSocket, so the single reset fires in whichever thread reaches a
+    # framed recv first.  When the client side wins, its connection is left
+    # half-open (the exception traceback pins the socket alive), and a
+    # tracker with no sock_timeout would park its accept loop in recvall on
+    # it forever — the timeout turns that race outcome into a rejected
+    # handshake instead of a hang.
+    tracker = RabitTracker("127.0.0.1", 1, sock_timeout=2.0)
     tracker.start(1)
     first = FakeRabitClient("127.0.0.1", tracker.port)
     t, box = _start_in_thread(first)
