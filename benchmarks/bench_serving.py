@@ -55,6 +55,26 @@ canary rising with the shifted distribution.
         [--fault-plan benchmarks/router_fault_plan.json | none]
         [--replicas 3] [--qps 50] [--duration 12] [--roll-duration 30]
 
+    python benchmarks/bench_serving.py c10k [--out c10k.json]
+        [--transport evloop] [--connections 10000] [--active 32]
+        [--churn-per-s 50] [--duration 10]
+
+``c10k`` is the event-loop transport's concurrency proof (docs/serving.md
+"Transport"): a REAL server subprocess (two fd budgets: ~10k client
+sockets here, ~10k accepted there), an idle keep-alive army of
+``--connections`` sockets churning at ``--churn-per-s`` while
+``--active`` workers score continuously.  Exits non-zero on any refused
+connect, any reset, any idle connection the server dropped early, or an
+army that never reached its target.
+
+    python benchmarks/bench_serving.py evloop-ab [--out ab.json]
+        [--qps 150] [--duration 6] [--rows 2]
+
+``evloop-ab`` races the two transports at matched offered load and
+reports client p50/p99 per transport plus the server-side per-stage p99
+attribution (request vs queue vs predict vs transport residue) that
+names where any tail difference lives.
+
 ``router`` is the multi-replica chaos drill (docs/serving.md
 "Multi-replica tier"): a ReplicaFleet of real scoring subprocesses
 behind an in-process RouterServer, four storms in sequence —
@@ -205,6 +225,189 @@ def run_knee(args) -> int:
             json.dump(out, f, indent=1, sort_keys=True)
         print(f"wrote {args.out}")
     return 0
+
+
+def _launch_server_subprocess(extra_args=(), extra_env=None):
+    """A REAL scoring-server subprocess on an ephemeral port (the c10k
+    drill needs two fd budgets: ~10k client sockets here, ~10k accepted
+    sockets there — one process cannot hold both under the rlimit).
+    Scrapes the stable ``serving <name> on <url>`` line for the URL."""
+    import subprocess
+    import threading
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "dmlc_core_tpu.serve", "--model",
+           "linear", "--num-feature", str(NUM_FEATURE), "--port", "0",
+           *extra_args]
+    proc = subprocess.Popen(cmd, cwd=repo_root, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    url = None
+    for line in proc.stdout:
+        if line.startswith("serving ") and " on " in line:
+            url = line.split(" on ", 1)[1].split()[0]
+            break
+    if url is None:
+        proc.kill()
+        raise RuntimeError("server subprocess died before binding")
+    # keep draining stdout so the child never blocks on a full pipe
+    threading.Thread(target=lambda: proc.stdout.read(),
+                     daemon=True).start()
+    return proc, url
+
+
+def _stop_server_subprocess(proc):
+    import signal as _signal
+
+    proc.send_signal(_signal.SIGTERM)
+    try:
+        proc.wait(30)
+    except Exception:
+        proc.kill()
+        proc.wait(10)
+
+
+def run_c10k(args) -> int:
+    """The 10k-concurrent-connections proof: a real evloop server
+    subprocess, an idle keep-alive army of --connections sockets churning
+    while --active workers score continuously.  Gate: zero refused
+    connects, zero resets, zero idle connections dropped early, and the
+    army actually reached the target."""
+    from dmlc_core_tpu.serve.loadgen import run_churn
+
+    proc, url = _launch_server_subprocess(
+        extra_args=["--transport", args.transport, "--max-batch", "32",
+                    "--max-delay-ms", "2.0"],
+        extra_env={"DMLC_SERVE_IDLE_S": str(max(120.0,
+                                                args.duration * 4))})
+    try:
+        report = run_churn(url, connections=args.connections,
+                           duration_s=args.duration,
+                           num_feature=NUM_FEATURE, active=args.active,
+                           churn_per_s=args.churn_per_s, seed=5)
+    finally:
+        _stop_server_subprocess(proc)
+    report["transport"] = args.transport
+    report["host"] = _host_info()
+
+    conns = report["connections"]
+    failures = []
+    if conns["refused"]:
+        failures.append(f"{conns['refused']} connects refused — the "
+                        "accept path shed at the kernel")
+    if conns["resets"]:
+        failures.append(f"{conns['resets']} connections reset "
+                        "mid-request")
+    if conns["closed_by_server"]:
+        failures.append(f"{conns['closed_by_server']} idle keep-alive "
+                        "connections dropped before the window closed")
+    if conns["peak_open"] < args.connections:
+        failures.append(f"peak open {conns['peak_open']} never reached "
+                        f"the {args.connections} target")
+    if report["requests"]["ok"] == 0:
+        failures.append("no request scored while the army held")
+    report["c10k_ok"] = not failures
+    report["c10k_failures"] = failures
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    print(json.dumps(report, indent=1, sort_keys=True))
+    print(f"\nc10k[{args.transport}]: peak {conns['peak_open']} open "
+          f"({conns['churned']} churned), {conns['refused']} refused, "
+          f"{conns['resets']} reset, {conns['closed_by_server']} dropped; "
+          f"{report['requests']['ok']} scored @ "
+          f"p99={report['latency_ms']['p99']}ms")
+    for msg in failures:
+        print(f"C10K FAILURE: {msg}")
+    return 0 if not failures else 1
+
+
+def _stage_p99_ms(server_stats):
+    """Per-stage p99s (ms) from a /stats snapshot: where the tail
+    actually lives.  transport_ms = whole-request p99 minus the
+    queue+predict p99s — parse, socket writes, and scheduling."""
+    stages = {}
+    for key, val in (server_stats or {}).get("metrics", {}).items():
+        if not isinstance(val, dict) or "p99" not in val:
+            continue
+        name = key.split("{", 1)[0]
+        short = {"dmlc_serve_request_seconds": "request",
+                 "dmlc_serve_queue_seconds": "queue",
+                 "dmlc_serve_predict_seconds": "predict"}.get(name)
+        if short is None:
+            continue
+        stages[short] = max(stages.get(short, 0.0), val["p99"] * 1e3)
+    if "request" in stages:
+        stages["transport"] = round(
+            stages["request"] - stages.get("queue", 0.0)
+            - stages.get("predict", 0.0), 3)
+    return {k: round(v, 3) for k, v in stages.items()}
+
+
+def run_evloop_ab(args) -> int:
+    """A/B the two transports at matched offered load: same qps, same
+    duration, same seed — client p50/p99 plus the server-side per-stage
+    p99 attribution (request vs queue vs predict vs transport) that
+    names where any tail difference comes from."""
+    from dmlc_core_tpu import telemetry
+    from dmlc_core_tpu.serve.loadgen import run_load
+
+    runs = {}
+    for transport in ("threaded", "evloop"):
+        telemetry.reset()  # fresh server-side histograms per leg
+        from dmlc_core_tpu.serve import ScoringServer, build_runtime
+
+        telemetry.enable()
+        server = ScoringServer(build_runtime("linear", NUM_FEATURE),
+                               max_batch=32, max_delay_ms=2.0,
+                               transport=transport).start()
+        try:
+            rep = run_load(server.url, qps=args.qps,
+                           duration_s=args.duration,
+                           num_feature=NUM_FEATURE,
+                           rows_per_request=args.rows, seed=17,
+                           timeout_s=8.0)
+        finally:
+            server.close()
+        runs[transport] = {
+            "counts": rep["counts"],
+            "connections": rep["connections"],
+            "achieved_qps": rep["achieved_qps"],
+            "latency_ms": rep["latency_ms"],
+            "latency_all_ms": rep["latency_all_ms"],
+            "slowest_traces": rep["slowest_traces"],
+            "stage_p99_ms": _stage_p99_ms(rep.get("server")),
+        }
+        lat = rep["latency_ms"]
+        print(f"{transport:<9} offered={args.qps:g} "
+              f"achieved={rep['achieved_qps']:<7g} p50={lat['p50']}ms "
+              f"p99={lat['p99']}ms stages={runs[transport]['stage_p99_ms']}")
+
+    report = {"host": _host_info(), "qps": args.qps,
+              "duration_s": args.duration, "rows_per_request": args.rows,
+              "num_feature": NUM_FEATURE, "runs": runs}
+    failures = []
+    for transport, r in runs.items():
+        c = r["counts"]
+        if c["crashed"] or c["error"]:
+            failures.append(f"{transport}: {c['crashed']} crashed + "
+                            f"{c['error']} unstructured errors")
+        if c["ok"] == 0:
+            failures.append(f"{transport}: no request succeeded")
+    report["ab_ok"] = not failures
+    report["ab_failures"] = failures
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        print(f"wrote {args.out}")
+    for msg in failures:
+        print(f"AB FAILURE: {msg}")
+    return 0 if not failures else 1
 
 
 def _bias_for(step: int) -> float:
@@ -1032,7 +1235,31 @@ def main(argv=None) -> int:
     rt.add_argument("--roll-duration", type=float, default=30.0,
                     help="rolling-restart phase seconds (must cover 3 "
                          "drain+relaunch+warmup cycles)")
+    ck = sub.add_parser("c10k",
+                        help="10k concurrent keep-alive connections "
+                             "against a real server subprocess")
+    ck.add_argument("--out", default=None)
+    ck.add_argument("--transport", default="evloop",
+                    choices=["threaded", "evloop"])
+    ck.add_argument("--connections", type=int, default=10000)
+    ck.add_argument("--active", type=int, default=32,
+                    help="keep-alive workers scoring continuously while "
+                         "the idle army holds")
+    ck.add_argument("--churn-per-s", type=float, default=50.0,
+                    help="idle connections closed+reopened per second")
+    ck.add_argument("--duration", type=float, default=10.0)
+    ab = sub.add_parser("evloop-ab",
+                        help="threaded vs evloop p99 at matched load, "
+                             "with per-stage tail attribution")
+    ab.add_argument("--out", default=None)
+    ab.add_argument("--qps", type=float, default=150.0)
+    ab.add_argument("--duration", type=float, default=6.0)
+    ab.add_argument("--rows", type=int, default=2)
     args = p.parse_args(argv)
+    if args.cmd == "c10k":
+        return run_c10k(args)
+    if args.cmd == "evloop-ab":
+        return run_evloop_ab(args)
     if args.cmd == "smoke":
         return run_smoke(args)
     if args.cmd == "lifecycle":

@@ -41,10 +41,10 @@ every knob.
 """
 
 from dmlc_core_tpu.serve.admission import AdmissionController  # noqa: F401
-from dmlc_core_tpu.serve.errors import (BadRequest, Overloaded,  # noqa: F401
-                                        PredictFailed, RequestTimeout,
-                                        ServeError, UnknownModel,
-                                        UpstreamFailed)
+from dmlc_core_tpu.serve.errors import (BadRequest, ClientTimeout,  # noqa: F401
+                                        Overloaded, PredictFailed,
+                                        RequestTimeout, ServeError,
+                                        UnknownModel, UpstreamFailed)
 from dmlc_core_tpu.serve.fleet import ReplicaFleet  # noqa: F401
 from dmlc_core_tpu.serve.lifecycle import (CheckpointWatcher,  # noqa: F401
                                            runtime_builder)
@@ -55,3 +55,6 @@ from dmlc_core_tpu.serve.registry import ModelRegistry, ModelSlot  # noqa: F401
 from dmlc_core_tpu.serve.router import Replica, RouterServer  # noqa: F401
 from dmlc_core_tpu.serve.scheduler import MicroBatcher, batch_buckets  # noqa: F401
 from dmlc_core_tpu.serve.server import ScoringServer  # noqa: F401
+# after .server: the event loop imports the shared request plumbing
+# (parse_instances, healthz_payload, route_slot) from there
+from dmlc_core_tpu.serve.eventloop import EventLoopServer  # noqa: F401

@@ -74,6 +74,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-hedge", action="store_true",
                    help="disable request hedging in the router "
                         "(--replicas > 1 only)")
+    p.add_argument("--transport", default=None,
+                   choices=["threaded", "evloop"],
+                   help="HTTP transport: 'threaded' (thread per "
+                        "connection) or 'evloop' (selectors event loop, "
+                        "10k+ keep-alive connections — docs/serving.md "
+                        "\"Transport\"; default: DMLC_SERVE_TRANSPORT "
+                        "or threaded)")
     return p
 
 
@@ -89,6 +96,10 @@ def _run_replicated(args: argparse.Namespace) -> int:
     extra_args: List[str] = []
     if args.no_warmup:
         extra_args.append("--no-warmup")
+    if args.transport:
+        # env propagates to replica subprocesses automatically; the
+        # explicit flag must reach them the same way
+        extra_args += ["--transport", args.transport]
     if args.watch_dir:
         extra_args += ["--watch-dir", args.watch_dir]
         if args.watch_interval_s is not None:
@@ -134,6 +145,20 @@ def _run_replicated(args: argparse.Namespace) -> int:
     return 0
 
 
+def _raise_nofile_limit() -> None:
+    """Best-effort soft→hard RLIMIT_NOFILE bump: a 10k-connection event
+    loop cannot live inside the usual 1024 soft cap, and raising to the
+    hard limit is always allowed."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if hard > soft:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except Exception:
+        pass
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = make_parser().parse_args(argv)
     # honor an explicit JAX_PLATFORMS request even under plugin-pinning
@@ -141,6 +166,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     from dmlc_core_tpu.utils.platform import sync_platform_from_env
 
     sync_platform_from_env()
+    _raise_nofile_limit()
     if args.replicas < 1:
         raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
     if args.replicas > 1:
@@ -157,7 +183,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                  max_queue_bytes=args.max_queue_bytes, default=True)
     server = ScoringServer(
         registry, host=args.host, port=args.port,
-        request_timeout_s=args.request_timeout_s, warmup=not args.no_warmup)
+        request_timeout_s=args.request_timeout_s,
+        warmup=not args.no_warmup, transport=args.transport)
     watcher = None
     if args.watch_dir:
         from dmlc_core_tpu.serve.lifecycle import (CheckpointWatcher,

@@ -20,8 +20,9 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, Optional
 
-__all__ = ["ServeError", "BadRequest", "Overloaded", "PredictFailed",
-           "RequestTimeout", "UnknownModel", "UpstreamFailed"]
+__all__ = ["ServeError", "BadRequest", "ClientTimeout", "Overloaded",
+           "PredictFailed", "RequestTimeout", "UnknownModel",
+           "UpstreamFailed"]
 
 
 class ServeError(Exception):
@@ -94,6 +95,19 @@ class RequestTimeout(ServeError):
 
     status = 504
     code = "timeout"
+
+
+class ClientTimeout(ServeError):
+    """The client failed to deliver its request bytes within the
+    transport's assembly deadline (408) — the event-loop transport's
+    slowloris / stalled-body defense (``DMLC_SERVE_HEADER_S``).  The
+    connection is closed after this envelope is written: a half-delivered
+    request leaves no framing to recover.  The threaded transport's
+    equivalent is a silent per-socket timeout close; a structured 408 is
+    strictly more diagnosable."""
+
+    status = 408
+    code = "client_timeout"
 
 
 class UpstreamFailed(ServeError):

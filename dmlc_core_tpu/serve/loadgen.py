@@ -61,7 +61,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from dmlc_core_tpu import telemetry
 from dmlc_core_tpu.telemetry import clock, tracecontext
 
-__all__ = ["run_load", "percentile", "LoadReport"]
+__all__ = ["run_load", "run_churn", "percentile", "LoadReport"]
 
 # how many worst-latency samples the report names by trace id
 SLOWEST_TRACES = 5
@@ -98,6 +98,13 @@ class _Recorder:
         self.lock = threading.Lock()
         self.counts = {k: 0 for k in OUTCOMES}
         self.statuses: Dict[str, int] = {}
+        # connection accounting: every SLO report states how many sockets
+        # were in flight at once and whether the transport ever slammed
+        # the door (refused = nothing listening; reset = RST mid-request)
+        self.inflight = 0
+        self.peak_inflight = 0
+        self.refused = 0
+        self.resets = 0
         # (latency_s, trace_id, outcome, status) per request — the single
         # store every latency view (quantiles, slowest table) derives from
         self.samples: List[Tuple[float, str, str, Optional[int]]] = []
@@ -107,6 +114,20 @@ class _Recorder:
         # per-window outcome counts, keyed by SCHEDULED arrival window —
         # what availability-during-a-kill-window gates are computed from
         self.windows: Dict[int, Dict[str, int]] = {}
+
+    def begin(self) -> None:
+        with self.lock:
+            self.inflight += 1
+            if self.inflight > self.peak_inflight:
+                self.peak_inflight = self.inflight
+
+    def end(self, conn_event: Optional[str]) -> None:
+        with self.lock:
+            self.inflight -= 1
+            if conn_event == "refused":
+                self.refused += 1
+            elif conn_event == "reset":
+                self.resets += 1
 
     def record(self, outcome: str, latency_s: float,
                status: Optional[int], trace_id: str,
@@ -170,7 +191,9 @@ def _mean_prediction(preds: List[Any]) -> Optional[float]:
 def _issue(url: str, path: str, body: bytes, timeout_s: float,
            expect_rows: int, traceparent: str, rows=None,
            response_check=None) -> tuple:
-    """One POST; returns (outcome, status|None, mean_prediction|None)."""
+    """One POST; returns (outcome, status|None, mean_prediction|None,
+    conn_event|None) where conn_event is ``"refused"`` (nothing was
+    listening) or ``"reset"`` (the transport tore the connection)."""
     req = urllib.request.Request(
         url + path, data=body,
         headers={"Content-Type": "application/json",
@@ -186,10 +209,10 @@ def _issue(url: str, path: str, body: bytes, timeout_s: float,
                     # inconsistent with the version it claims): worse
                     # than a shed, and the one outcome a half-swapped
                     # model could produce
-                    return "invalid", resp.status, None
-                return "ok", resp.status, _mean_prediction(preds)
+                    return "invalid", resp.status, None, None
+                return "ok", resp.status, _mean_prediction(preds), None
             # 200 with a wrong-shaped body
-            return "crashed", resp.status, None
+            return "crashed", resp.status, None, None
     except urllib.error.HTTPError as e:
         status = e.code
         try:
@@ -198,33 +221,37 @@ def _issue(url: str, path: str, body: bytes, timeout_s: float,
         except Exception:
             structured = False
         if not structured:
-            return "crashed", status, None
+            return "crashed", status, None, None
         if status == 503:
-            return "shed", status, None
+            return "shed", status, None, None
         if status == 504:
-            return "timeout", status, None
+            return "timeout", status, None, None
         if 400 <= status < 500:
-            return "rejected", status, None
-        return "error", status, None
+            return "rejected", status, None, None
+        return "error", status, None, None
     except TimeoutError:
-        return "timeout", None, None
+        return "timeout", None, None, None
     except urllib.error.URLError as e:
         # urllib wraps connect-phase deadline expiry in URLError: that is
         # the client's deadline, not a server crash
         reason = getattr(e, "reason", None)
         if isinstance(reason, TimeoutError):
-            return "timeout", None, None
+            return "timeout", None, None, None
         if isinstance(reason, ConnectionRefusedError):
             # nothing listening on the port: a replica/router restart
             # window, not a dropped in-flight request
-            return "rejected", None, None
-        return "crashed", None, None
+            return "rejected", None, None, "refused"
+        if isinstance(reason, ConnectionResetError):
+            return "crashed", None, None, "reset"
+        return "crashed", None, None, None
     except ConnectionRefusedError:
-        return "rejected", None, None
+        return "rejected", None, None, "refused"
+    except ConnectionResetError:
+        return "crashed", None, None, "reset"
     except (ConnectionError, OSError):
-        return "crashed", None, None
+        return "crashed", None, None, None
     except Exception:
-        return "crashed", None, None
+        return "crashed", None, None, None
 
 
 def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
@@ -273,10 +300,12 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
         tp = tracecontext.format_traceparent(
             tracecontext.TraceContext(trace_id, span_id))
         t0 = clock.monotonic()
-        outcome, status, mean_pred = _issue(url, path, body, timeout_s,
-                                            rows_per_request, tp, rows,
-                                            response_check)
+        rec.begin()
+        outcome, status, mean_pred, conn_event = _issue(
+            url, path, body, timeout_s, rows_per_request, tp, rows,
+            response_check)
         t1 = clock.monotonic()
+        rec.end(conn_event)
         telemetry.record_span("client.request", t0, t1,
                               trace=(trace_id, span_id, None),
                               outcome=outcome, status=status or 0)
@@ -325,6 +354,16 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
             "p50": _ms(percentile(lat_all, 0.50)),
             "p99": _ms(percentile(lat_all, 0.99)),
         },
+        # connection accounting in EVERY report: how many sockets were in
+        # flight at the peak, and whether the transport ever slammed the
+        # door.  refused = connect got ECONNREFUSED (restart window or an
+        # exhausted backlog); resets = the socket was torn (RST) after
+        # bytes moved.  The c10k gate reads refused == resets == 0.
+        "connections": {
+            "peak_inflight": rec.peak_inflight,
+            "refused": rec.refused,
+            "resets": rec.resets,
+        },
         # the worst offenders BY NAME: feed these ids to
         # `telemetry trace <dir>` to see where each one's time went
         "slowest_traces": rec.slowest(SLOWEST_TRACES),
@@ -357,6 +396,192 @@ def run_load(url: str, *, qps: float, duration_s: float, num_feature: int,
 
 def _ms(seconds: Optional[float]) -> Optional[float]:
     return None if seconds is None else round(seconds * 1e3, 3)
+
+
+def _raise_nofile_limit(need: int) -> None:
+    """Best-effort bump of RLIMIT_NOFILE toward ``need`` descriptors so a
+    c10k client army doesn't die on the default soft limit; silently does
+    nothing where resource limits are unavailable or capped below need."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = min(hard, max(soft, need))
+        if want > soft:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+    except Exception:
+        pass
+
+
+def run_churn(url: str, *, connections: int, duration_s: float,
+              num_feature: int, active: int = 32,
+              churn_per_s: float = 0.0, seed: int = 0,
+              timeout_s: float = 10.0) -> Dict[str, Any]:
+    """High-concurrency connection-churn scenario: the c10k drill.
+
+    Opens ``connections`` raw keep-alive sockets that sit **idle** (the
+    realistic shape of 10k+ concurrent clients: most are between
+    requests), while ``active`` keep-alive HTTP workers score requests
+    continuously over their own persistent connections.  Optionally
+    churns the idle army at ``churn_per_s`` (close one, open a fresh
+    one) to exercise accept/close pressure under load.
+
+    The verdict the report carries:
+
+    - ``connections.refused`` — connects the OS bounced (full backlog or
+      nothing listening).  Must be 0 for the c10k claim.
+    - ``connections.resets`` — sockets torn mid-request (RST).  Must be 0.
+    - ``connections.closed_by_server`` — idle army sockets the server
+      dropped during the window (idle-timeout misfires show up here).
+    - ``connections.peak_open`` — idle army + active workers actually
+      connected at once: the concurrency actually demonstrated.
+
+    The caller is responsible for a server whose idle timeout
+    (``DMLC_SERVE_IDLE_S``) exceeds ``duration_s``.
+    """
+    import socket
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(url)
+    host, port = parts.hostname or "127.0.0.1", parts.port or 80
+    _raise_nofile_limit(connections + active + 64)
+    rng = random.Random(seed)
+
+    idle: List[Any] = []
+    refused = 0
+    open_errors = 0
+    opened_total = 0
+    for _ in range(connections):
+        try:
+            s = socket.create_connection((host, port), timeout=timeout_s)
+            idle.append(s)
+            opened_total += 1
+        except ConnectionRefusedError:
+            refused += 1
+        except OSError:
+            open_errors += 1
+
+    body = json.dumps(
+        {"instances": _gen_rows(rng, 1, num_feature)}).encode()
+    lock = threading.Lock()
+    stats = {"ok": 0, "errors": 0, "resets": 0}
+    lats: List[float] = []
+    start = clock.monotonic()
+    stop_at = start + duration_s
+
+    def worker() -> None:
+        import http.client
+
+        conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+        try:
+            while clock.monotonic() < stop_at:
+                t0 = clock.monotonic()
+                try:
+                    conn.request("POST", "/v1/score", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    status = resp.status
+                except (ConnectionResetError, BrokenPipeError):
+                    with lock:
+                        stats["resets"] += 1
+                    conn.close()
+                    continue
+                except (ConnectionError, OSError,
+                        http.client.HTTPException):
+                    with lock:
+                        stats["errors"] += 1
+                    conn.close()
+                    continue
+                with lock:
+                    if status == 200:
+                        stats["ok"] += 1
+                        lats.append(clock.monotonic() - t0)
+                    else:
+                        stats["errors"] += 1
+        finally:
+            conn.close()
+
+    workers = [threading.Thread(target=worker, daemon=True)
+               for _ in range(active)]
+    for t in workers:
+        t.start()
+
+    churned = 0
+    peak_open = len(idle) + active
+    while clock.monotonic() < stop_at:
+        if churn_per_s > 0 and idle:
+            # swap one idle soldier: close + reconnect (accept pressure
+            # while the request path is busy)
+            victim = idle.pop(rng.randrange(len(idle)))
+            try:
+                victim.close()
+            except OSError:
+                pass
+            try:
+                s = socket.create_connection((host, port),
+                                             timeout=timeout_s)
+                idle.append(s)
+                opened_total += 1
+                churned += 1
+            except ConnectionRefusedError:
+                refused += 1
+            except OSError:
+                open_errors += 1
+            peak_open = max(peak_open, len(idle) + active)
+            time.sleep(1.0 / churn_per_s)
+        else:
+            time.sleep(0.05)
+    for t in workers:
+        t.join(timeout_s + 5.0)
+
+    # roll call: any idle soldier the server dropped (EOF/RST waiting in
+    # its buffer) is a broken keep-alive promise
+    closed_by_server = 0
+    for s in idle:
+        try:
+            s.setblocking(False)
+            if s.recv(1) == b"":
+                closed_by_server += 1
+        except (BlockingIOError, InterruptedError):
+            pass  # still open and silent: the healthy case
+        except OSError:
+            closed_by_server += 1
+        finally:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    wall = clock.monotonic() - start
+    lat = sorted(lats)
+    report: Dict[str, Any] = {
+        "target_connections": connections,
+        "active_workers": active,
+        "duration_s": duration_s,
+        "wall_s": round(wall, 3),
+        "connections": {
+            "peak_open": peak_open,
+            "opened_total": opened_total + active,
+            "churned": churned,
+            "refused": refused,
+            "resets": stats["resets"],
+            "open_errors": open_errors,
+            "closed_by_server": closed_by_server,
+        },
+        "requests": {"ok": stats["ok"], "errors": stats["errors"]},
+        "achieved_qps": round(stats["ok"] / wall, 2) if wall else 0.0,
+        "latency_ms": {
+            "p50": _ms(percentile(lat, 0.50)),
+            "p95": _ms(percentile(lat, 0.95)),
+            "p99": _ms(percentile(lat, 0.99)),
+            "max": _ms(lat[-1] if lat else None),
+        },
+    }
+    server_stats = _fetch_stats(url, timeout_s)
+    if server_stats is not None:
+        report["server"] = server_stats
+    return report
 
 
 def _fetch_stats(url: str, timeout_s: float) -> Optional[Dict[str, Any]]:

@@ -50,9 +50,17 @@ from dmlc_core_tpu.telemetry.report import (REPORT_QUANTILES, _label_str,
                                             estimate_quantiles)
 from dmlc_core_tpu.utils.logging import log_debug, log_info, log_warning
 
-__all__ = ["ScoringServer", "parse_instances"]
+__all__ = ["ScoringServer", "parse_instances", "healthz_payload",
+           "route_slot"]
 
 MAX_BODY_BYTES = 8 << 20  # one request, not a bulk upload
+
+# the two transports behind DMLC_SERVE_TRANSPORT: "threaded" is the
+# original ThreadingHTTPServer (one handler thread per connection),
+# "evloop" is the selectors-based non-blocking front end
+# (serve/eventloop.py) that holds 10k+ keep-alive connections on a
+# couple of event-loop threads
+TRANSPORTS = ("threaded", "evloop")
 
 
 def parse_instances(obj: Any, num_feature: int) -> np.ndarray:
@@ -117,6 +125,36 @@ def parse_instances(obj: Any, num_feature: int) -> np.ndarray:
     return out
 
 
+def healthz_payload(app: "ScoringServer") -> Dict[str, Any]:
+    """The enriched ``/healthz`` body both transports serve: "status"
+    keeps the plain ok/draining probe semantics existing checks rely on,
+    "admission" adds the per-model load state the router routes on
+    (queue-bytes, budget, shed EWMA)."""
+    default = app.registry.get()
+    return {
+        "status": "draining" if app.draining else "ok",
+        "model": default.family,
+        "version": default.version,
+        "num_feature": default.num_feature,
+        "max_batch": default.batcher.max_batch,
+        "models": app.registry.describe(),
+        "admission": {
+            name: app.registry.get(name).admission.describe()
+            for name in app.registry.names()},
+        "in_flight": app.in_flight,
+        "uptime_s": round(clock.monotonic() - app.started_at, 3)}
+
+
+def route_slot(app: "ScoringServer", path: str) -> ModelSlot:
+    """``/v1/score`` -> default slot; ``/v1/score/<model>`` -> named
+    slot (structured 404 for unknown names, 400 for other paths)."""
+    if path == "/v1/score":
+        return app.registry.get()
+    if path.startswith("/v1/score/"):
+        return app.registry.get(path[len("/v1/score/"):])
+    raise BadRequest(f"no such path {path!r}")
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dmlc-serve/0.1"
     protocol_version = "HTTP/1.1"
@@ -163,24 +201,7 @@ class _Handler(BaseHTTPRequestHandler):
         app = self.app
         try:
             if self.path == "/healthz":
-                default = app.registry.get()
-                # the enriched liveness contract: "status" keeps the plain
-                # ok/draining probe semantics existing checks rely on,
-                # "admission" adds the per-model load state the router
-                # routes on (queue-bytes, budget, shed EWMA)
-                self._respond_json(200, {
-                    "status": "draining" if app.draining else "ok",
-                    "model": default.family,
-                    "version": default.version,
-                    "num_feature": default.num_feature,
-                    "max_batch": default.batcher.max_batch,
-                    "models": app.registry.describe(),
-                    "admission": {
-                        name: app.registry.get(name).admission.describe()
-                        for name in app.registry.names()},
-                    "in_flight": app.in_flight,
-                    "uptime_s": round(clock.monotonic() - app.started_at,
-                                      3)})
+                self._respond_json(200, healthz_payload(app))
             elif self.path == "/metrics":
                 self._respond(200, telemetry.prometheus_text().encode(),
                               content_type="text/plain; version=0.0.4")
@@ -286,13 +307,7 @@ class _Handler(BaseHTTPRequestHandler):
                               status=status)
 
     def _route(self, app: "ScoringServer") -> ModelSlot:
-        """``/v1/score`` -> default slot; ``/v1/score/<model>`` -> named
-        slot (structured 404 for unknown names, 400 for other paths)."""
-        if self.path == "/v1/score":
-            return app.registry.get()
-        if self.path.startswith("/v1/score/"):
-            return app.registry.get(self.path[len("/v1/score/"):])
-        raise BadRequest(f"no such path {self.path!r}")
+        return route_slot(app, self.path)
 
     def _score(self, app: "ScoringServer", slot: ModelSlot) \
             -> Tuple[int, Dict[str, Any], Optional[Dict[str, str]]]:
@@ -381,7 +396,8 @@ class ScoringServer:
                  port: int = 0, max_batch: int = 64,
                  max_delay_ms: float = 2.0,
                  max_queue_bytes: Optional[int] = None,
-                 request_timeout_s: float = 10.0, warmup: bool = True):
+                 request_timeout_s: float = 10.0, warmup: bool = True,
+                 transport: Optional[str] = None):
         if isinstance(model, ModelRegistry):
             # slots already carry their own knobs: a knob passed HERE
             # would be silently dropped — make the misuse loud instead
@@ -400,8 +416,26 @@ class ScoringServer:
                               default=True)
         self.request_timeout_s = float(request_timeout_s)
         self._warmup = warmup
-        self._httpd = _Server((host, port), _Handler)
-        self._httpd.app = self  # type: ignore[attr-defined]
+        # transport selection: the argument wins, then DMLC_SERVE_TRANSPORT,
+        # then the threaded default — the env form is what lets the parity
+        # test rig (and a fleet of replica subprocesses) flip every server
+        # in a process tree without touching call sites
+        if transport is None:
+            transport = os.environ.get("DMLC_SERVE_TRANSPORT", "threaded")
+        transport = (transport or "threaded").strip().lower()
+        if transport not in TRANSPORTS:
+            raise ValueError(
+                f"unknown serve transport {transport!r}: expected one of "
+                f"{TRANSPORTS} (DMLC_SERVE_TRANSPORT)")
+        self.transport = transport
+        if transport == "evloop":
+            # imported lazily: eventloop imports this module for the
+            # shared request plumbing (parse_instances, healthz_payload)
+            from dmlc_core_tpu.serve.eventloop import EventLoopServer
+            self._httpd = EventLoopServer((host, port), app=self)
+        else:
+            self._httpd = _Server((host, port), _Handler)
+            self._httpd.app = self  # type: ignore[attr-defined]
         self._serve_thread: Optional[threading.Thread] = None
         # drain/lifecycle state: handler threads bump the in-flight
         # odometer, the drain path and /healthz read it
@@ -464,7 +498,7 @@ class ScoringServer:
         if names:
             default = self.registry.get()
             log_info(f"serve: listening on {self.url} "
-                     f"(models={names}, "
+                     f"(transport={self.transport}, models={names}, "
                      f"default={default.name}:{default.family}, "
                      f"max_batch={default.batcher.max_batch}, "
                      f"max_delay_ms={default.batcher.max_delay_s * 1e3:g}, "

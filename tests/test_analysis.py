@@ -3693,6 +3693,59 @@ def test_real_registry_is_race_clean():
     assert found == []
 
 
+def test_real_eventloop_is_race_clean():
+    src = _real_source("dmlc_core_tpu/serve/eventloop.py")
+    assert _races_on_sources(
+        {"dmlc_core_tpu/serve/eventloop.py": src}) == []
+
+
+def test_seeded_unlocked_conn_table_write_in_real_eventloop():
+    """Re-introducing lock-free writes to the EventLoopServer._conns
+    fd table (the one cross-thread table: accept/close write it from
+    loop threads, server_close clears it from the caller's thread,
+    the sweep snapshots it lock-free) produces exactly ONE finding
+    with the right rule id pinned to the table."""
+    src = _real_source("dmlc_core_tpu/serve/eventloop.py")
+    broken = src.replace(
+        "            conn.loop_idx = target\n"
+        "            with self._lock:\n"
+        "                self._conns[conn.fd] = conn\n"
+        "                if target != idx:\n"
+        "                    self._inbox[target].append(conn)",
+        "            conn.loop_idx = target\n"
+        "            self._conns[conn.fd] = conn\n"
+        "            if target != idx:\n"
+        "                self._inbox[target].append(conn)")
+    broken2 = broken.replace(
+        "        with self._lock:\n"
+        "            self._conns.pop(conn.fd, None)",
+        "        self._conns.pop(conn.fd, None)")
+    broken3 = broken2.replace(
+        "            with self._lock:\n"
+        "                mine = [c for c in self._conns.values()\n"
+        "                        if c.loop_idx == idx]\n"
+        "                for c in mine:\n"
+        "                    self._conns.pop(c.fd, None)",
+        "            mine = [c for c in self._conns.values()\n"
+        "                    if c.loop_idx == idx]\n"
+        "            for c in mine:\n"
+        "                self._conns.pop(c.fd, None)")
+    broken4 = broken3.replace(
+        "        with self._lock:\n"
+        "            leftovers = list(self._conns.values())\n"
+        "            self._conns.clear()",
+        "        leftovers = list(self._conns.values())\n"
+        "        self._conns.clear()")
+    for a, b in ((src, broken), (broken, broken2), (broken2, broken3),
+                 (broken3, broken4)):
+        assert a != b, "fix shape changed; update the seeding"
+    found = _races_on_sources(
+        {"dmlc_core_tpu/serve/eventloop.py": broken4})
+    assert len(found) == 1
+    assert found[0].rule == "race-unlocked-shared-write"
+    assert found[0].symbol == "EventLoopServer._conns"
+
+
 def test_seeded_unlocked_error_ferry_in_real_rendezvous():
     """Regression for the fixed ShardLeaseCoordinator.error race: the
     serve loop's crash report must ride the ledger lock, because

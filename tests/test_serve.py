@@ -312,11 +312,15 @@ def test_build_runtime_unknown_kind():
 
 # -- HTTP surface -------------------------------------------------------------
 
-@pytest.fixture(scope="module")
-def linear_server():
+# parametrized over both transports: every HTTP contract test below runs
+# against the threaded ThreadingHTTPServer AND the selectors event loop
+# with zero test forks (docs/serving.md "Transport")
+@pytest.fixture(scope="module", params=["threaded", "evloop"])
+def linear_server(request):
     rt = build_runtime("linear", 4, seed=0)
     server = ScoringServer(rt, max_batch=4, max_delay_ms=1.0,
-                           request_timeout_s=10.0)
+                           request_timeout_s=10.0,
+                           transport=request.param)
     with server:
         yield server
 

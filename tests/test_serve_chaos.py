@@ -31,6 +31,14 @@ def _clean_fault_plan():
     fault.clear()
 
 
+# every chaos drill runs against BOTH transports (threaded + evloop) with
+# zero test forks: _server() resolves DMLC_SERVE_TRANSPORT from the env
+@pytest.fixture(autouse=True, params=["threaded", "evloop"])
+def _transport(request, monkeypatch):
+    monkeypatch.setenv("DMLC_SERVE_TRANSPORT", request.param)
+    yield request.param
+
+
 def _server(**kw):
     kw.setdefault("max_batch", 4)
     kw.setdefault("max_delay_ms", 1.0)

@@ -1,0 +1,279 @@
+"""Event-loop transport chaos: the hostile-client drills only a
+non-blocking front end can survive.
+
+The threaded transport holds one thread hostage per slow client; the
+selectors transport (``DMLC_SERVE_TRANSPORT=evloop``) must instead
+*time-box* every connection: byte-at-a-time headers (slowloris) and
+stalled bodies get a structured 408 and a close, mid-response
+disconnects are counted as aborts without crashing anything, pipelined
+requests are answered in order, and idle keep-alive connections are
+reaped silently.  Cross-transport behavior parity lives in
+test_serve.py / test_serve_chaos.py (parametrized over both transports);
+this file owns the drills that only make sense against the event loop.
+"""
+
+import json
+import socket
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dmlc_core_tpu import fault, telemetry
+from dmlc_core_tpu.serve import ScoringServer, build_runtime
+from dmlc_core_tpu.serve.loadgen import run_churn, run_load
+
+pytestmark = pytest.mark.chaos
+
+NF = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _server(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_delay_ms", 1.0)
+    kw.setdefault("transport", "evloop")
+    return ScoringServer(build_runtime("linear", NF, seed=0), **kw)
+
+
+def _post(url, obj, timeout=10.0):
+    body = obj if isinstance(obj, bytes) else json.dumps(obj).encode()
+    req = urllib.request.Request(
+        url + "/v1/score", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def _healthy(url):
+    with urllib.request.urlopen(url + "/healthz", timeout=5) as resp:
+        return json.load(resp)["status"] == "ok"
+
+
+def _connect(srv):
+    host, port = srv.url.replace("http://", "").rsplit(":", 1)
+    return socket.create_connection((host, int(port)), timeout=10.0)
+
+
+def _read_response(sock, timeout=10.0):
+    """Read exactly one HTTP response off a raw socket; returns
+    (status, headers dict, body bytes)."""
+    sock.settimeout(timeout)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("EOF before response head")
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split(" ", 2)[1])
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    need = int(headers.get("content-length", "0"))
+    while len(rest) < need:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("EOF mid-body")
+        rest += chunk
+    return status, headers, rest[:need], rest[need:]
+
+
+def test_slowloris_headers_get_structured_408_then_close(monkeypatch):
+    # a client that drips header bytes forever must not pin a connection
+    # (much less a thread): the header deadline fires, the envelope is a
+    # parseable 408, and the socket is closed
+    monkeypatch.setenv("DMLC_SERVE_HEADER_S", "0.5")
+    with _server() as srv:
+        s = _connect(srv)
+        try:
+            s.sendall(b"POST /v1/score HT")
+            time.sleep(0.15)
+            s.sendall(b"TP/1.1\r\nContent-")
+            status, headers, body, _ = _read_response(s, timeout=10.0)
+            assert status == 408
+            err = json.loads(body)["error"]
+            assert err["code"] == "client_timeout"
+            assert err["details"]["timeout_s"] == 0.5
+            # and the connection is gone: EOF on the next read
+            assert s.recv(1) == b""
+        finally:
+            s.close()
+        # the loop thread survived: normal traffic flows right after
+        status, body = _post(srv.url, {"instances": [[0.0] * NF]})
+        assert status == 200 and len(body["predictions"]) == 1
+        assert _healthy(srv.url)
+
+
+def test_stalled_body_gets_structured_408_then_close(monkeypatch):
+    # full headers, partial body, then silence: the assembly deadline
+    # covers the body too (the request began — abort accounting applies)
+    monkeypatch.setenv("DMLC_SERVE_HEADER_S", "0.5")
+    with _server() as srv:
+        s = _connect(srv)
+        try:
+            s.sendall(b"POST /v1/score HTTP/1.1\r\n"
+                      b"Host: x\r\nContent-Type: application/json\r\n"
+                      b"Content-Length: 400\r\n\r\n"
+                      b'{"instances": [[')
+            status, headers, body, _ = _read_response(s, timeout=10.0)
+            assert status == 408
+            assert json.loads(body)["error"]["code"] == "client_timeout"
+            assert s.recv(1) == b""
+        finally:
+            s.close()
+        status, _ = _post(srv.url, {"instances": [[0.5] * NF]})
+        assert status == 200
+        assert _healthy(srv.url)
+
+
+def test_mid_response_disconnect_counted_as_abort_not_crash():
+    # the client RSTs while its request is in the batcher: the loop
+    # records an abort (status-0 metrics + the aborts counter) and the
+    # late completion is dropped by the seq guard — nothing crashes
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    fault.configure({"rules": [{"site": "serve.predict", "kind": "delay",
+                                "seconds": 0.4, "times": None}]})
+    try:
+        with _server() as srv:
+            before = telemetry.get_registry().counter(
+                "dmlc_serve_connection_aborts_total").value
+            s = _connect(srv)
+            payload = json.dumps(
+                {"instances": [[0.0] * NF]}).encode()
+            s.sendall(b"POST /v1/score HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: %d\r\n\r\n" % len(payload)
+                      + payload)
+            time.sleep(0.1)  # let the loop submit to the batcher
+            # SO_LINGER(0): close sends RST instead of FIN
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack("ii", 1, 0))
+            s.close()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                after = telemetry.get_registry().counter(
+                    "dmlc_serve_connection_aborts_total").value
+                if after > before:
+                    break
+                time.sleep(0.05)
+            assert after > before, "abort was never counted"
+            # the server shrugged it off
+            fault.clear()
+            status, _ = _post(srv.url, {"instances": [[1.0] * NF]})
+            assert status == 200
+            assert _healthy(srv.url)
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_pipelined_requests_answered_in_order():
+    # two complete requests in one TCP segment: the loop must answer
+    # both, in order, on the same connection (framing discipline)
+    with _server() as srv:
+        p1 = json.dumps({"instances": [[1.0] * NF]}).encode()
+        p2 = json.dumps({"instances": [[2.0] * NF, [3.0] * NF]}).encode()
+        wire = b"".join(
+            b"POST /v1/score HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(p) + p
+            for p in (p1, p2))
+        s = _connect(srv)
+        try:
+            s.sendall(wire)
+            status1, _, body1, extra = _read_response(s)
+            assert status1 == 200
+            assert len(json.loads(body1)["predictions"]) == 1
+            # the second response may already be in `extra`
+            buf = extra
+            if b"\r\n\r\n" not in buf:
+                status2, _, body2, _ = _read_response(s)
+            else:
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                lines = head.decode("latin-1").split("\r\n")
+                status2 = int(lines[0].split(" ", 2)[1])
+                need = int([v for k, _, v in
+                            (l.partition(":") for l in lines[1:])
+                            if k.strip().lower() == "content-length"][0])
+                while len(rest) < need:
+                    rest += s.recv(65536)
+                body2 = rest[:need]
+            assert status2 == 200
+            assert len(json.loads(body2)["predictions"]) == 2
+        finally:
+            s.close()
+
+
+def test_idle_keepalive_connections_are_reaped_silently(monkeypatch):
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    monkeypatch.setenv("DMLC_SERVE_IDLE_S", "0.5")
+    try:
+        with _server() as srv:
+            s = _connect(srv)
+            try:
+                time.sleep(1.2)  # > idle timeout + sweep period
+                # silent close: EOF, no error envelope
+                s.settimeout(5.0)
+                assert s.recv(1) == b""
+            finally:
+                s.close()
+            reaped = telemetry.get_registry().counter(
+                "dmlc_serve_connections_closed_total",
+                reason="idle_timeout").value
+            assert reaped >= 1
+            # fresh connections are still welcome
+            status, _ = _post(srv.url, {"instances": [[0.0] * NF]})
+            assert status == 200
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_churn_report_shows_zero_refused_zero_resets(monkeypatch):
+    # the c10k drill in miniature (the full 10k run lives in
+    # benchmarks/bench_serving.py c10k): hundreds of mostly-idle
+    # keep-alive connections churning while traffic flows — nothing
+    # refused, nothing reset, no idle soldier dropped early
+    monkeypatch.setenv("DMLC_SERVE_IDLE_S", "60")
+    with _server() as srv:
+        report = run_churn(srv.url, connections=256, duration_s=1.5,
+                           num_feature=NF, active=8, churn_per_s=20,
+                           seed=3)
+        conns = report["connections"]
+        assert conns["refused"] == 0
+        assert conns["resets"] == 0
+        assert conns["closed_by_server"] == 0
+        assert conns["peak_open"] >= 256
+        assert conns["churned"] > 0
+        assert report["requests"]["ok"] > 0
+        assert report["requests"]["errors"] == 0
+        assert _healthy(srv.url)
+
+
+def test_every_slo_report_carries_connection_accounting():
+    # satellite contract: run_load's report states peak concurrent
+    # connections and door-slam counts unconditionally
+    with _server() as srv:
+        report = run_load(srv.url, qps=30, duration_s=1.0,
+                          num_feature=NF, seed=7)
+        conns = report["connections"]
+        assert set(conns) == {"peak_inflight", "refused", "resets"}
+        assert conns["peak_inflight"] >= 1
+        assert conns["refused"] == 0 and conns["resets"] == 0
+        assert report["counts"]["crashed"] == 0
